@@ -1,0 +1,27 @@
+"""stmgcn-tpu: a TPU-native spatiotemporal multi-graph convolution framework.
+
+A from-scratch JAX/XLA/Pallas/pjit framework with the capabilities of the
+PyTorch reference `underdoc-wang/ST-MGCN` (AAAI'19 "Spatiotemporal Multi-Graph
+Convolution Network for Ride-Hailing Demand Forecasting"), redesigned
+TPU-first:
+
+- ``stmgcn_tpu.ops``      graph-support construction, fused Chebyshev graph
+                          convolution, ``lax.scan`` LSTM, Pallas kernels.
+- ``stmgcn_tpu.data``     NPZ demand loading, normalization, vectorized
+                          serial/daily/weekly windowing, splits, batching.
+- ``stmgcn_tpu.models``   contextual-gated LSTM and the ST-MGCN flagship
+                          model (M graph branches vmapped, not looped).
+- ``stmgcn_tpu.parallel`` device mesh, sharding specs, halo exchange for the
+                          partitioned region axis, collective helpers.
+- ``stmgcn_tpu.train``    optax optimization, jitted train/eval steps,
+                          best-on-validation checkpointing, early stopping,
+                          resumable training state.
+- ``stmgcn_tpu.cli``      typed configuration presets and the command line
+                          entry point.
+
+Layer map and parity citations against the reference live in ``SURVEY.md`` at
+the repository root; every public module docstring cites the reference
+behavior (``file:line`` under ``/root/reference``) it is equivalent to.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,31 @@
+"""Numeric ops: graph-support builders, graph convolution, recurrence, kernels."""
+
+from stmgcn_tpu.ops.graph import (
+    SupportConfig,
+    build_supports,
+    chebyshev_polynomials,
+    chebyshev_supports,
+    diffusion_supports,
+    localpool_supports,
+    max_eigenvalue,
+    normalized_laplacian,
+    random_walk_normalize,
+    rescale_laplacian,
+    support_count,
+    symmetric_normalize,
+)
+
+__all__ = [
+    "SupportConfig",
+    "build_supports",
+    "chebyshev_polynomials",
+    "chebyshev_supports",
+    "diffusion_supports",
+    "localpool_supports",
+    "max_eigenvalue",
+    "normalized_laplacian",
+    "random_walk_normalize",
+    "rescale_laplacian",
+    "support_count",
+    "symmetric_normalize",
+]

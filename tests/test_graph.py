@@ -1,0 +1,144 @@
+"""Unit tests for graph-support construction (SURVEY.md §4: C7 closed-form checks)."""
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.ops import graph
+
+
+def path3():
+    # 0 - 1 - 2 path graph, degrees [1, 2, 1]
+    return np.array([[0, 1, 0], [1, 0, 1], [0, 1, 0]], dtype=np.float64)
+
+
+class TestNormalizations:
+    def test_symmetric_normalize_path3_closed_form(self):
+        got = graph.symmetric_normalize(path3())
+        s = 1.0 / np.sqrt(2.0)
+        want = np.array([[0, s, 0], [s, 0, s], [0, s, 0]])
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_symmetric_normalize_isolated_node_is_finite(self):
+        a = np.zeros((3, 3))
+        a[0, 1] = a[1, 0] = 1.0  # node 2 isolated
+        got = graph.symmetric_normalize(a)
+        assert np.isfinite(got).all()
+        assert (got[2] == 0).all() and (got[:, 2] == 0).all()
+
+    def test_random_walk_rows_sum_to_one(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((6, 6))
+        np.fill_diagonal(a, 0)
+        got = graph.random_walk_normalize(a)
+        np.testing.assert_allclose(got.sum(axis=1), np.ones(6), atol=1e-12)
+
+    def test_laplacian_psd_spectrum(self):
+        lap = graph.normalized_laplacian(path3())
+        eig = np.linalg.eigvalsh(lap)
+        assert eig.min() >= -1e-10
+        assert eig.max() <= 2.0 + 1e-10
+
+
+class TestEigenRescale:
+    def test_rescaled_spectrum_in_unit_interval(self):
+        rng = np.random.default_rng(1)
+        a = rng.random((12, 12))
+        a = (a + a.T) / 2
+        np.fill_diagonal(a, 0)
+        lap = graph.normalized_laplacian(a)
+        eig = np.linalg.eigvalsh(graph.rescale_laplacian(lap))
+        assert eig.max() <= 1.0 + 1e-8
+        assert eig.min() >= -1.0 - 1e-8
+
+    def test_power_iteration_matches_dense(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((40, 40))
+        a = (a + a.T) / 2
+        np.fill_diagonal(a, 0)
+        lap = graph.normalized_laplacian(a)
+        dense = graph.max_eigenvalue(lap, method="dense")
+        power = graph.max_eigenvalue(lap, method="power")
+        np.testing.assert_allclose(power, dense, rtol=1e-5)
+
+    def test_fallback_lambda_max(self, monkeypatch):
+        # Reference semantics: non-convergent eig -> lambda_max = 2 (GCN.py:119-121)
+        def boom(*a, **k):
+            raise np.linalg.LinAlgError("no convergence")
+
+        monkeypatch.setattr(np.linalg, "eigvalsh", boom)
+        monkeypatch.setattr(np.linalg, "eigvals", boom)
+        lam = graph.max_eigenvalue(graph.normalized_laplacian(path3()), method="dense")
+        assert lam == 2.0
+
+
+class TestChebyshev:
+    def test_polynomial_recursion(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((5, 5))
+        t = graph.chebyshev_polynomials(x, K=3)
+        assert t.shape == (4, 5, 5)
+        np.testing.assert_allclose(t[0], np.eye(5))
+        np.testing.assert_allclose(t[1], x)
+        np.testing.assert_allclose(t[2], 2 * x @ t[1] - t[0])
+        np.testing.assert_allclose(t[3], 2 * x @ t[2] - t[1])
+
+    def test_chebyshev_supports_shape_and_t0(self):
+        sup = graph.chebyshev_supports(path3(), K=2)
+        assert sup.shape == (3, 3, 3)
+        np.testing.assert_allclose(sup[0], np.eye(3))
+
+    def test_scalar_chebyshev_identity(self):
+        # On a 1x1 "graph" the supports are literal Chebyshev values T_k(x).
+        x = np.array([[0.3]])
+        t = graph.chebyshev_polynomials(x, K=4)
+        vals = t[:, 0, 0]
+        want = [1.0, 0.3, 2 * 0.3 ** 2 - 1, np.cos(3 * np.arccos(0.3)), np.cos(4 * np.arccos(0.3))]
+        np.testing.assert_allclose(vals, want, atol=1e-12)
+
+
+class TestKernelFamilies:
+    def test_localpool_is_identity_plus_norm(self):
+        sup = graph.localpool_supports(path3())
+        np.testing.assert_allclose(sup[0], np.eye(3) + graph.symmetric_normalize(path3()))
+
+    def test_diffusion_counts(self):
+        a = path3()
+        assert graph.diffusion_supports(a, K=2, bidirectional=False).shape[0] == 3
+        assert graph.diffusion_supports(a, K=2, bidirectional=True).shape[0] == 5
+
+    def test_diffusion_symmetric_graph_fwd_bwd_agree(self):
+        a = path3()
+        sup = graph.diffusion_supports(a, K=2, bidirectional=True)
+        np.testing.assert_allclose(sup[1], sup[3], atol=1e-12)
+        np.testing.assert_allclose(sup[2], sup[4], atol=1e-12)
+
+    def test_support_count_table(self):
+        # Mirrors reference ST_MGCN.get_support_K (STMGCN.py:80-91)
+        assert graph.support_count("chebyshev", 2) == 3
+        assert graph.support_count("localpool", 1) == 1
+        assert graph.support_count("random_walk_diffusion", 2) == 5
+        assert graph.support_count("random_walk_diffusion", 2, bidirectional=False) == 3
+        with pytest.raises(ValueError):
+            graph.support_count("localpool", 2)
+        with pytest.raises(ValueError):
+            graph.support_count("nope", 1)
+
+
+class TestSupportConfig:
+    def test_build_all_stacks_m_graphs(self):
+        cfg = graph.SupportConfig("chebyshev", K=2)
+        assert cfg.n_supports == 3
+        rng = np.random.default_rng(4)
+        adjs = []
+        for _ in range(3):
+            a = rng.random((7, 7))
+            a = (a + a.T) / 2
+            np.fill_diagonal(a, 0)
+            adjs.append(a)
+        stacked = cfg.build_all(adjs)
+        assert stacked.shape == (3, 3, 7, 7)
+        assert stacked.dtype == np.float32
+
+    def test_invalid_kernel_type_raises(self):
+        with pytest.raises(ValueError):
+            graph.SupportConfig("invalid")

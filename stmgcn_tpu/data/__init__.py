@@ -1,0 +1,34 @@
+"""Data layer: NPZ loading, normalization, windowing, splits, batching.
+
+TPU-native counterpart of the reference's ``Data_Container.py`` (L1 in
+SURVEY.md §1): same sample semantics, but windowing is a single vectorized
+gather instead of a Python loop over time, split indices are computed in
+timesteps (fixing the reference's day-vs-timestep unit bug, SURVEY.md §2
+quirk 3), and device placement is explicit and shardable instead of eager
+``.to(device)`` at dataset construction.
+"""
+
+from stmgcn_tpu.data.loader import ADJ_KEYS, DemandData, load_npz
+from stmgcn_tpu.data.normalize import MinMaxNormalizer, StdNormalizer, normalizer_from_dict
+from stmgcn_tpu.data.pipeline import DemandDataset, Batch
+from stmgcn_tpu.data.splits import SplitSpec, date_splits
+from stmgcn_tpu.data.synthetic import synthetic_demand, grid_adjacency, synthetic_dataset
+from stmgcn_tpu.data.windowing import WindowSpec, sliding_windows
+
+__all__ = [
+    "ADJ_KEYS",
+    "Batch",
+    "DemandData",
+    "DemandDataset",
+    "MinMaxNormalizer",
+    "StdNormalizer",
+    "SplitSpec",
+    "WindowSpec",
+    "date_splits",
+    "grid_adjacency",
+    "load_npz",
+    "normalizer_from_dict",
+    "sliding_windows",
+    "synthetic_dataset",
+    "synthetic_demand",
+]

@@ -1,0 +1,132 @@
+"""End-to-end data pipeline: normalize -> window -> split -> batch.
+
+Counterpart of the reference's ``DataGenerator.get_data_loader`` +
+``TaxiDataset`` (``Data_Container.py:54-123``), redesigned for TPU:
+
+- windows are built once, vectorized, on the host (float32 numpy);
+- splits are *views* into the sample arrays (no per-mode copies);
+- batching yields host numpy — device placement is the trainer's decision
+  (``jax.device_put`` once for small configs, sharded placement for meshes)
+  rather than an eager ``.to(device)`` inside the dataset
+  (``Data_Container.py:88-89``, SURVEY.md §2 quirk 7);
+- the last partial batch can be dropped or padded to keep shapes static
+  under ``jit`` (the reference's DataLoader lets the tail batch ragged).
+
+Reference parity defaults: min-max normalization over the full tensor,
+``shuffle=False`` for every mode (``Data_Container.py:122``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from stmgcn_tpu.data.loader import DemandData
+from stmgcn_tpu.data.normalize import MinMaxNormalizer
+from stmgcn_tpu.data.splits import MODES, SplitSpec, fraction_splits
+from stmgcn_tpu.data.windowing import WindowSpec, sliding_windows
+
+__all__ = ["Batch", "DemandDataset"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """One step's input: ``x`` ``(B, seq_len, N, C)``, target ``y`` ``(B, N, C)``."""
+
+    x: np.ndarray
+    y: np.ndarray
+    #: number of *real* (non-padding) samples; == len(y) except for a padded tail
+    n_real: int
+
+    def __len__(self) -> int:
+        return self.y.shape[0]
+
+
+class DemandDataset:
+    """Windowed, normalized, split demand samples with batch iteration."""
+
+    def __init__(
+        self,
+        data: DemandData,
+        window: WindowSpec,
+        split: SplitSpec | None = None,
+        normalize: bool = True,
+    ):
+        self.window = window
+        self.normalizer = MinMaxNormalizer.fit(data.demand) if normalize else None
+        demand = (
+            self.normalizer.transform(data.demand) if normalize else data.demand
+        ).astype(np.float32)
+        self.x, self.y = sliding_windows(demand, window)
+        self.split = (
+            split.validate_against(self.n_samples)
+            if split is not None
+            else fraction_splits(self.n_samples)
+        )
+        self.adjs = data.adjs
+
+    @property
+    def n_samples(self) -> int:
+        return self.y.shape[0]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.y.shape[1]
+
+    @property
+    def n_feats(self) -> int:
+        return self.y.shape[2]
+
+    def arrays(self, mode: str) -> tuple[np.ndarray, np.ndarray]:
+        """Full ``(x, y)`` views for a mode (no copy)."""
+        start, stop = self.split.range_for(mode)
+        return self.x[start:stop], self.y[start:stop]
+
+    def denormalize(self, values):
+        if self.normalizer is None:
+            return values
+        return self.normalizer.inverse(values)
+
+    def num_batches(self, mode: str, batch_size: int, drop_last: bool = False) -> int:
+        n = self.split.mode_len[mode]
+        return n // batch_size if drop_last else -(-n // batch_size)
+
+    def batches(
+        self,
+        mode: str,
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        seed: int = 0,
+        epoch: int = 0,
+        drop_last: bool = False,
+        pad_last: bool = False,
+    ) -> Iterator[Batch]:
+        """Yield :class:`Batch` es over a mode.
+
+        ``pad_last`` repeats the final sample to fill the tail batch so every
+        batch has the same static shape under ``jit``; ``Batch.n_real`` lets
+        the loss/metrics mask the padding. ``shuffle`` reshuffles per epoch
+        with a deterministic ``(seed, epoch)`` stream.
+        """
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if drop_last and pad_last:
+            raise ValueError("drop_last and pad_last are mutually exclusive")
+        x, y = self.arrays(mode)
+        n = y.shape[0]
+        order = None
+        if shuffle:
+            order = np.random.default_rng((seed, epoch)).permutation(n)
+        stop = n - n % batch_size if drop_last else n
+        for i in range(0, stop, batch_size):
+            idx = slice(i, min(i + batch_size, n))
+            bx, by = (x[order[idx]], y[order[idx]]) if order is not None else (x[idx], y[idx])
+            n_real = by.shape[0]
+            if pad_last and n_real < batch_size:
+                reps = batch_size - n_real
+                bx = np.concatenate([bx, np.repeat(bx[-1:], reps, axis=0)])
+                by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
+            yield Batch(x=bx, y=by, n_real=n_real)

@@ -1,0 +1,115 @@
+"""Vectorized serial / daily-periodic / weekly-periodic window extraction.
+
+Reference semantics (``Data_Container.py:125-146``, verified in SURVEY.md §2
+C3/C5) reproduced exactly, but as **one fancy-index gather** over a
+precomputed offset table instead of a Python loop over every timestep — the
+reference's hottest host-side loop (SURVEY.md §3.1).
+
+Pinned semantics:
+
+- burn-in ``= max(serial_len, daily_len*day_steps, weekly_len*day_steps*7)``
+  (``Data_Container.py:127``): the first sample's target is the first
+  timestep with a full history.
+- serial component: the ``serial_len`` timesteps immediately before the
+  target (``Data_Container.py:129``).
+- periodic components use skip stride ``p_len * period`` — i.e. the *d*-th
+  daily lag sits ``d * daily_len`` days back, not ``d`` days
+  (``Data_Container.py:138-140``); same for weekly with period ``7`` — and
+  are emitted oldest-first (the ``[::-1]`` at ``Data_Container.py:145``).
+- concatenation order along the sequence axis is
+  ``[weekly | daily | serial]`` (``Data_Container.py:83-86``), with
+  zero-length components skipped (the ``ndim != 2`` test at
+  ``Data_Container.py:84``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["WindowSpec", "sliding_windows"]
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    """Observation-window lengths (reference CLI ``-cpt s d w``, ``Main.py:30-33``).
+
+    ``day_timesteps`` is the number of timesteps per day (``24 // dt``,
+    ``Data_Container.py:96``).
+    """
+
+    serial_len: int = 3
+    daily_len: int = 1
+    weekly_len: int = 1
+    day_timesteps: int = 24
+
+    def __post_init__(self):
+        if min(self.serial_len, self.daily_len, self.weekly_len) < 0:
+            raise ValueError("window lengths must be >= 0")
+        if self.seq_len == 0:
+            raise ValueError("at least one window component must be non-empty")
+        if self.day_timesteps <= 0:
+            raise ValueError("day_timesteps must be positive")
+
+    @property
+    def seq_len(self) -> int:
+        """Total model sequence length (reference ``sum(obs_len)``, ``Main.py:62``)."""
+        return self.serial_len + self.daily_len + self.weekly_len
+
+    @property
+    def burn_in(self) -> int:
+        """Timesteps of history needed before the first target.
+
+        The reference computes ``max(s, d*day_steps, w*day_steps*7)``
+        (``Data_Container.py:127``), but because the periodic skip stride is
+        itself scaled by the component length (``p_steps * k`` for lag ``k``,
+        ``Data_Container.py:138-144``) the deepest lag reaches
+        ``p_len**2 * period`` timesteps back — for ``daily_len`` or
+        ``weekly_len`` >= 2 the reference's first samples wrap to *negative*
+        indices and silently read future data. Fixed here by covering the
+        deepest actual lag; identical to the reference for the default
+        ``(3, 1, 1)`` config (168).
+        """
+        return max(
+            self.serial_len,
+            self.daily_len**2 * self.day_timesteps,
+            self.weekly_len**2 * self.day_timesteps * 7,
+        )
+
+    @property
+    def offsets(self) -> np.ndarray:
+        """Gather offsets (relative to the target index) in ``[weekly|daily|serial]`` order."""
+        parts = []
+        if self.weekly_len:
+            stride = self.weekly_len * self.day_timesteps * 7
+            parts.append(-stride * np.arange(self.weekly_len, 0, -1))
+        if self.daily_len:
+            stride = self.daily_len * self.day_timesteps
+            parts.append(-stride * np.arange(self.daily_len, 0, -1))
+        if self.serial_len:
+            parts.append(np.arange(-self.serial_len, 0))
+        return np.concatenate(parts)
+
+
+def sliding_windows(data, spec: WindowSpec) -> tuple[np.ndarray, np.ndarray]:
+    """Extract all ``(x_seq, y)`` samples from a ``(T, N, C)`` demand tensor.
+
+    Returns ``x`` of shape ``(S, seq_len, N, C)`` and ``y`` of shape
+    ``(S, N, C)`` where ``S = T - spec.burn_in``; sample ``i`` targets
+    timestep ``spec.burn_in + i``. Equivalent to the reference's
+    ``get_feats`` + per-mode concatenation (``Data_Container.py:125-146`` and
+    ``:82-86``) in a single gather.
+    """
+    data = np.asarray(data)
+    if data.ndim < 1:
+        raise ValueError("data must have a leading time axis")
+    T = data.shape[0]
+    if T <= spec.burn_in:
+        raise ValueError(
+            f"need more than burn_in={spec.burn_in} timesteps, got T={T}"
+        )
+    targets = np.arange(spec.burn_in, T)
+    x = data[targets[:, None] + spec.offsets[None, :]]
+    y = data[targets]
+    return x, y

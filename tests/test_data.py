@@ -1,0 +1,244 @@
+"""Data pipeline tests (SURVEY.md §4: C1-C6 windowing/normalization/split parity)."""
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.data import (
+    Batch,
+    DemandDataset,
+    MinMaxNormalizer,
+    StdNormalizer,
+    WindowSpec,
+    date_splits,
+    grid_adjacency,
+    load_npz,
+    sliding_windows,
+    synthetic_dataset,
+    synthetic_demand,
+)
+from stmgcn_tpu.data.splits import fraction_splits
+
+
+def loop_windows(data, s, d, w, day_steps):
+    """Straightforward per-timestep loop implementing the pinned reference
+    semantics (SURVEY.md §2 C3/C5: burn-in, skip strides d*day_steps and
+    w*day_steps*7, oldest-first periodic order, [weekly|daily|serial] concat).
+    Used as the oracle for the vectorized gather."""
+    serial, daily, weekly, ys = [], [], [], []
+    # corrected burn-in: covers the deepest periodic lag p_len**2 * period
+    # (the reference's own start_idx under-covers for p_len >= 2 and wraps)
+    start = max(s, d * d * day_steps, w * w * day_steps * 7)
+    for i in range(start, len(data)):
+        serial.append(data[i - s : i])
+        daily.append(np.array([data[i - d * day_steps * k] for k in range(1, d + 1)][::-1]))
+        weekly.append(np.array([data[i - w * day_steps * 7 * k] for k in range(1, w + 1)][::-1]))
+        ys.append(data[i])
+    parts = [np.array(weekly), np.array(daily), np.array(serial)]
+    parts = [p for p in parts if p.ndim != 2]  # Data_Container.py:84 empty-seq test
+    return np.concatenate(parts, axis=1), np.array(ys)
+
+
+class TestWindowing:
+    @pytest.mark.parametrize(
+        "s,d,w,day_steps",
+        [(3, 1, 1, 24), (2, 2, 1, 24), (3, 0, 0, 24), (0, 1, 0, 24),
+         (0, 0, 2, 4), (5, 2, 2, 4), (1, 1, 1, 4)],
+    )
+    def test_matches_loop_oracle(self, s, d, w, day_steps):
+        spec = WindowSpec(s, d, w, day_steps)
+        T = spec.burn_in + 50
+        data = np.random.default_rng(0).standard_normal((T, 6, 2)).astype(np.float32)
+        x, y = sliding_windows(data, spec)
+        x_ref, y_ref = loop_windows(data, s, d, w, day_steps)
+        assert x.shape == (T - spec.burn_in, spec.seq_len, 6, 2)
+        np.testing.assert_array_equal(x, x_ref)
+        np.testing.assert_array_equal(y, y_ref)
+
+    def test_burn_in_and_seq_len(self):
+        spec = WindowSpec(3, 1, 1, 24)  # the reference default (-cpt 3 1 1)
+        assert spec.seq_len == 5
+        assert spec.burn_in == 168
+
+    def test_too_short_series_raises(self):
+        with pytest.raises(ValueError, match="burn_in"):
+            sliding_windows(np.zeros((168, 4, 1)), WindowSpec(3, 1, 1, 24))
+
+    def test_all_empty_raises(self):
+        with pytest.raises(ValueError):
+            WindowSpec(0, 0, 0, 24)
+
+    def test_negative_length_raises(self):
+        with pytest.raises(ValueError):
+            WindowSpec(-1, 1, 1, 24)
+
+
+class TestNormalize:
+    def test_minmax_range_and_roundtrip(self):
+        x = np.random.default_rng(1).gamma(2.0, 20.0, size=(100, 5, 1))
+        norm = MinMaxNormalizer.fit(x)
+        z = norm.transform(x)
+        assert z.min() == pytest.approx(-1.0) and z.max() == pytest.approx(1.0)
+        np.testing.assert_allclose(norm.inverse(z), x, rtol=1e-12)
+
+    def test_std_roundtrip(self):
+        x = np.random.default_rng(2).standard_normal((50, 3))
+        norm = StdNormalizer.fit(x)
+        z = norm.transform(x)
+        assert abs(z.mean()) < 1e-12 and z.std() == pytest.approx(1.0)
+        np.testing.assert_allclose(norm.inverse(z), x, atol=1e-12)
+
+    def test_serialization_roundtrip(self):
+        from stmgcn_tpu.data.normalize import normalizer_from_dict
+
+        norm = MinMaxNormalizer(minimum=-3.0, maximum=7.0)
+        assert normalizer_from_dict(norm.to_dict()) == norm
+
+
+class TestSplits:
+    def test_reference_default_dates(self):
+        # Main.py defaults: -date 0101 0630 0701 0731, dt=1, val_ratio=0.2
+        spec = date_splits(["0101", "0630", "0701", "0731"], day_timesteps=24,
+                           val_ratio=0.2, year=2017, burn_in=168)
+        # 181 train days * 24 = 4344; val = int(4344*0.2) = 868; train = 3476
+        assert spec.mode_len == {"train": 3476, "validate": 868, "test": 744}
+        assert spec.start_idx == 0  # clamped: 0101 starts inside the burn-in
+        assert spec.range_for("train") == (0, 3476)
+        assert spec.range_for("validate") == (3476, 4344)
+        assert spec.range_for("test") == (4344, 5088)
+
+    def test_unit_bug_fix_mid_year_start(self):
+        # Reference would index sample arrays with the *day* index 14
+        # (SURVEY.md §2 quirk 3); correct is 14*24 - burn_in timesteps.
+        spec = date_splits(["0115", "0131", "0201", "0207"], day_timesteps=24,
+                           burn_in=168)
+        assert spec.start_idx == 14 * 24 - 168
+
+    def test_bounds_check(self):
+        with pytest.raises(ValueError, match="only"):
+            date_splits(["0101", "0630", "0701", "0731"], day_timesteps=24,
+                        burn_in=168, n_samples=100)
+
+    def test_descending_dates_raise(self):
+        with pytest.raises(ValueError, match="ascending"):
+            date_splits(["0630", "0101", "0701", "0731"])
+
+    def test_fraction_splits(self):
+        spec = fraction_splits(100, train=0.7, validate=0.1)
+        assert spec.mode_len == {"train": 70, "validate": 10, "test": 20}
+        with pytest.raises(ValueError):
+            fraction_splits(100, train=0.9, validate=0.2)
+
+
+class TestLoader:
+    def test_roundtrip_and_key_gating(self, tmp_path):
+        ds = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2)
+        path = tmp_path / "data_dict.npz"
+        np.savez(path, taxi=ds.demand, **ds.adjs)
+        for m in (1, 2, 3):
+            loaded = load_npz(str(path), m_graphs=m)
+            assert loaded.n_graphs == m
+            assert list(loaded.adjs)[:1] == ["neighbor_adj"]
+        np.testing.assert_array_equal(loaded.demand, ds.demand)
+
+    def test_2d_demand_expanded(self, tmp_path):
+        path = tmp_path / "d.npz"
+        np.savez(path, taxi=np.zeros((10, 4)), neighbor_adj=np.eye(4))
+        assert load_npz(str(path), m_graphs=1).demand.shape == (10, 4, 1)
+
+    def test_missing_demand_key(self, tmp_path):
+        path = tmp_path / "d.npz"
+        np.savez(path, other=np.zeros((10, 4)))
+        with pytest.raises(KeyError):
+            load_npz(str(path), m_graphs=1)
+
+    def test_too_few_adjs(self, tmp_path):
+        path = tmp_path / "d.npz"
+        np.savez(path, taxi=np.zeros((10, 4, 1)), neighbor_adj=np.eye(4))
+        with pytest.raises(ValueError, match="adjacency"):
+            load_npz(str(path), m_graphs=3)
+
+    def test_adj_shape_mismatch(self, tmp_path):
+        path = tmp_path / "d.npz"
+        np.savez(path, taxi=np.zeros((10, 4, 1)), neighbor_adj=np.eye(5))
+        with pytest.raises(ValueError, match="shape"):
+            load_npz(str(path), m_graphs=1)
+
+    def test_custom_adj_keys_after_canonical(self, tmp_path):
+        path = tmp_path / "d.npz"
+        np.savez(path, taxi=np.zeros((10, 4, 1)), neighbor_adj=np.eye(4),
+                 road_adj=np.eye(4))
+        loaded = load_npz(str(path), m_graphs=2)
+        assert list(loaded.adjs) == ["neighbor_adj", "road_adj"]
+
+
+class TestSynthetic:
+    def test_shapes_and_nonnegativity(self):
+        ds = synthetic_dataset(rows=5, n_timesteps=24 * 7 * 2)
+        assert ds.demand.shape == (24 * 7 * 2, 25, 1)
+        assert (ds.demand >= 0).all()
+        assert ds.n_graphs == 3
+        for a in ds.adj_list():
+            assert a.shape == (25, 25)
+            np.testing.assert_array_equal(a, a.T)
+            assert np.diag(a).sum() == 0
+
+    def test_grid_adjacency_degree(self):
+        adj = grid_adjacency(3)
+        # corner degree 2, edge 3, center 4
+        deg = adj.sum(1)
+        assert sorted(deg.tolist()) == [2, 2, 2, 2, 3, 3, 3, 3, 4]
+
+
+class TestPipeline:
+    def make(self, **kw):
+        ds = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 3, seed=3)
+        return DemandDataset(ds, WindowSpec(3, 1, 1, 24), **kw)
+
+    def test_split_views_and_denormalize(self):
+        raw = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 3, seed=3)
+        dd = DemandDataset(raw, WindowSpec(3, 1, 1, 24))
+        x, y = dd.arrays("train")
+        assert x.shape[1:] == (5, 9, 1)
+        # denormalized targets reproduce the raw demand exactly
+        np.testing.assert_allclose(
+            dd.denormalize(y), raw.demand[168 : 168 + len(y)], rtol=1e-5, atol=1e-4
+        )
+
+    def test_batch_iteration_counts(self):
+        dd = self.make()
+        n = dd.split.mode_len["train"]
+        batches = list(dd.batches("train", 32))
+        assert len(batches) == -(-n // 32) == dd.num_batches("train", 32)
+        assert sum(b.n_real for b in batches) == n
+        assert all(isinstance(b, Batch) for b in batches)
+
+    def test_pad_last_static_shapes(self):
+        dd = self.make()
+        batches = list(dd.batches("validate", 32, pad_last=True))
+        assert all(len(b) == 32 for b in batches)
+        assert batches[-1].n_real == (dd.split.mode_len["validate"] % 32 or 32)
+
+    def test_drop_last(self):
+        dd = self.make()
+        n = dd.split.mode_len["train"]
+        batches = list(dd.batches("train", 32, drop_last=True))
+        assert len(batches) == n // 32
+        assert all(len(b) == 32 for b in batches)
+
+    def test_shuffle_deterministic_per_epoch(self):
+        dd = self.make()
+        a = [b.y for b in dd.batches("train", 16, shuffle=True, seed=7, epoch=1)]
+        b = [b.y for b in dd.batches("train", 16, shuffle=True, seed=7, epoch=1)]
+        c = [b.y for b in dd.batches("train", 16, shuffle=True, seed=7, epoch=2)]
+        np.testing.assert_array_equal(np.concatenate(a), np.concatenate(b))
+        assert not np.array_equal(np.concatenate(a), np.concatenate(c))
+
+    def test_batch_xy_alignment_under_shuffle(self):
+        dd = self.make()
+        x_all, y_all = dd.arrays("train")
+        for b in dd.batches("train", 16, shuffle=True, seed=1):
+            for bx, by in zip(b.x, b.y):
+                # each y must be the sample following its own x window
+                matches = np.where((y_all == by).all(axis=(1, 2)))[0]
+                assert any((x_all[m] == bx).all() for m in matches)
+            break

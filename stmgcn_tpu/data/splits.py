@@ -86,10 +86,10 @@ def _day_of_year(year: int, mmdd: str) -> int:
 def date_splits(
     dates,
     *,
+    burn_in: int,
     day_timesteps: int = 24,
     val_ratio: float = 0.2,
     year: int = 2017,
-    burn_in: int = 0,
     n_samples: int | None = None,
 ) -> SplitSpec:
     """Build a :class:`SplitSpec` from ``[train_start, train_end, test_start, test_end]``.
@@ -102,7 +102,10 @@ def date_splits(
     when the train start date falls inside the initial burn-in window (as
     the default ``0101`` start does) the split begins at the first sample
     with a full history — the position the reference's ``start_idx = 0``
-    denotes. Pass ``n_samples`` to bounds-check the split extents.
+    denotes. A clamp that actually moves a non-day-0 start is warned about.
+    ``burn_in`` is a required keyword (pass ``WindowSpec.burn_in``) so the
+    fix cannot be silently skipped. Pass ``n_samples`` to bounds-check the
+    split extents.
     """
     if len(dates) != 4:
         raise ValueError("dates must be [train_start, train_end, test_start, test_end]")
@@ -124,6 +127,13 @@ def date_splits(
     val_len = int(train_len * val_ratio)
     train_len -= val_len
     test_len = (s1 + 1 - s0) * day_timesteps
+    if 0 < t0 * day_timesteps < burn_in:
+        warnings.warn(
+            f"train start {dates[0]} falls inside the {burn_in}-timestep window "
+            "burn-in; the split begins at the first sample with a full history, "
+            f"{burn_in - t0 * day_timesteps} timesteps after the named date",
+            stacklevel=2,
+        )
     spec = SplitSpec(
         start_idx=max(0, t0 * day_timesteps - burn_in),
         mode_len={"train": train_len, "validate": val_len, "test": test_len},

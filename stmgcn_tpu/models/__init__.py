@@ -1,0 +1,6 @@
+"""Model layer: contextual-gated LSTM branches and the ST-MGCN flagship."""
+
+from stmgcn_tpu.models.cg_lstm import CGLSTM, ContextualGate
+from stmgcn_tpu.models.st_mgcn import STMGCN, Branch
+
+__all__ = ["CGLSTM", "ContextualGate", "STMGCN", "Branch"]

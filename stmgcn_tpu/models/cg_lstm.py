@@ -1,0 +1,118 @@
+"""Contextual-gated LSTM (CGRNN) — one graph branch's recurrent encoder.
+
+TPU-native counterpart of the reference's ``CG_LSTM``
+(``/root/reference/STMGCN.py:7-57``), implementing paper eqs. 6-9:
+
+1. each region's length-T history is treated as its feature vector and
+   graph-convolved over the support stack (eq. 6 with residual,
+   ``STMGCN.py:40-41``);
+2. global average pooling over *nodes* then an FC -> ReLU -> FC -> sigmoid
+   produces per-timestep attention weights (eqs. 7-8, ``STMGCN.py:42-43``);
+3. the observation sequence is reweighted per timestep (eq. 9,
+   ``STMGCN.py:44``) and fed through a globally-shared LSTM with nodes
+   folded into the batch axis (``STMGCN.py:47-50``), keeping the last
+   timestep's hidden state.
+
+Reference quirk 1 (SURVEY.md §2): the reference applies the *same*
+``nn.Linear`` twice in eq. 8 (``s = sigmoid(fc(relu(fc(z))))``,
+``STMGCN.py:20,43``) where the paper has two distinct layers.
+``shared_gate_fc=True`` (default) reproduces the reference; ``False`` gives
+the paper's two-layer gate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from stmgcn_tpu.ops.chebconv import ChebGraphConv
+from stmgcn_tpu.ops.lstm import StackedLSTM
+
+__all__ = ["CGLSTM", "ContextualGate"]
+
+
+class ContextualGate(nn.Module):
+    """Per-timestep sigmoid attention from graph-convolved temporal features."""
+
+    n_supports: int
+    seq_len: int
+    use_bias: bool = True
+    activation: Optional[Callable] = nn.relu
+    shared_gate_fc: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, supports: jnp.ndarray, obs_seq: jnp.ndarray) -> jnp.ndarray:
+        """``obs_seq`` ``(B, T, N, C)`` -> gated ``(B, T, N, C)``."""
+        x_seq = obs_seq.sum(axis=-1)  # collapse features (STMGCN.py:36)
+        x_nt = x_seq.transpose(0, 2, 1)  # (B, N, T): history as node features
+        g = ChebGraphConv(
+            n_supports=self.n_supports,
+            features=self.seq_len,
+            use_bias=self.use_bias,
+            activation=self.activation,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="temporal_gconv",
+        )(supports, x_nt)
+        x_hat = x_nt + g  # eq. 6 residual
+        z = x_hat.mean(axis=1)  # eq. 7: average pool over nodes -> (B, T)
+
+        fc = nn.Dense(
+            self.seq_len, dtype=self.dtype, param_dtype=self.param_dtype, name="gate_fc"
+        )
+        inner = fc(z)
+        second = (
+            fc
+            if self.shared_gate_fc
+            else nn.Dense(
+                self.seq_len, dtype=self.dtype, param_dtype=self.param_dtype, name="gate_fc2"
+            )
+        )
+        s = nn.sigmoid(second(nn.relu(inner)))  # eq. 8
+        return obs_seq * s[:, :, None, None]  # eq. 9
+
+
+class CGLSTM(nn.Module):
+    """Contextual gate + globally-shared LSTM; returns ``(B, N, lstm_hidden)``."""
+
+    n_supports: int
+    seq_len: int
+    lstm_hidden_dim: int
+    lstm_num_layers: int
+    use_bias: bool = True
+    activation: Optional[Callable] = nn.relu
+    shared_gate_fc: bool = True
+    remat: bool = False
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, supports: jnp.ndarray, obs_seq: jnp.ndarray) -> jnp.ndarray:
+        batch, seq_len, n_nodes, n_feats = obs_seq.shape
+        gated = ContextualGate(
+            n_supports=self.n_supports,
+            seq_len=self.seq_len,
+            use_bias=self.use_bias,
+            activation=self.activation,
+            shared_gate_fc=self.shared_gate_fc,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="gate",
+        )(supports, obs_seq)
+
+        # Fold nodes into batch for the shared recurrence (STMGCN.py:47).
+        folded = gated.transpose(0, 2, 1, 3).reshape(batch * n_nodes, seq_len, n_feats)
+        outputs, _ = StackedLSTM(
+            hidden_dim=self.lstm_hidden_dim,
+            num_layers=self.lstm_num_layers,
+            remat=self.remat,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="lstm",
+        )(folded)
+        last = outputs[:, -1, :]  # (B*N, H) — keep final timestep (STMGCN.py:50)
+        return last.reshape(batch, n_nodes, self.lstm_hidden_dim)

@@ -1,5 +1,6 @@
 """Numeric ops: graph-support builders, graph convolution, recurrence, kernels."""
 
+from stmgcn_tpu.ops.chebconv import ChebGraphConv
 from stmgcn_tpu.ops.graph import (
     SupportConfig,
     build_supports,
@@ -14,8 +15,11 @@ from stmgcn_tpu.ops.graph import (
     support_count,
     symmetric_normalize,
 )
+from stmgcn_tpu.ops.lstm import StackedLSTM
 
 __all__ = [
+    "ChebGraphConv",
+    "StackedLSTM",
     "SupportConfig",
     "build_supports",
     "chebyshev_polynomials",

@@ -1,0 +1,69 @@
+"""K-support graph convolution as one fused contraction.
+
+TPU-native counterpart of the reference's dense ``GCN`` module
+(``/root/reference/GCN.py:7-46``): where the reference runs a Python loop of
+K separate ``einsum('ij,bjp->bip')`` calls and concatenates
+(``GCN.py:33-37``), this layer evaluates all K support propagations in a
+single ``einsum('kij,bjf->bikf')`` — one batched contraction XLA tiles onto
+the MXU — followed by the shared ``(K*F_in, F_out)`` projection.
+
+Parameter layout parity: the weight is a single ``(K*F_in, F_out)`` matrix
+(``GCN.py:18``) and the reshape of the ``(B, N, K, F)`` propagated tensor is
+k-major, matching ``torch.cat(support_list, dim=-1)`` ordering exactly, so
+reference-trained weights map 1:1. Xavier-normal weight init and zero bias
+(``GCN.py:17-22``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["ChebGraphConv"]
+
+
+class ChebGraphConv(nn.Module):
+    """Graph convolution over a stack of K dense support matrices.
+
+    Call with ``supports`` of shape ``(K, N, N)`` and a signal ``x`` of
+    shape ``(B, N, F_in)``; returns ``(B, N, features)``.
+    """
+
+    n_supports: int
+    features: int
+    use_bias: bool = True
+    activation: Optional[Callable] = nn.relu
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, supports: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+        if supports.shape[0] != self.n_supports:  # GCN.py:31
+            raise ValueError(
+                f"expected {self.n_supports} supports, got {supports.shape[0]}"
+            )
+        batch, n_nodes, f_in = x.shape
+        w = self.param(
+            "W",
+            nn.initializers.xavier_normal(),
+            (self.n_supports * f_in, self.features),
+            self.param_dtype,
+        )
+        b = (
+            self.param("b", nn.initializers.zeros_init(), (self.features,), self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        supports, x, w, b = nn.dtypes.promote_dtype(supports, x, w, b, dtype=self.dtype)
+
+        # All K propagations at once; k-major flatten == torch.cat order.
+        propagated = jnp.einsum("kij,bjf->bikf", supports, x)
+        stacked = propagated.reshape(batch, n_nodes, self.n_supports * f_in)
+        out = stacked @ w
+        if b is not None:
+            out = out + b
+        if self.activation is not None:
+            out = self.activation(out)
+        return out

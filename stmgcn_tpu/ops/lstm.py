@@ -1,0 +1,108 @@
+"""Multi-layer LSTM as a ``lax.scan`` recurrence.
+
+TPU-native counterpart of the reference's cuDNN-fused ``nn.LSTM``
+(``/root/reference/STMGCN.py:21,48``). Designed for XLA rather than
+translated:
+
+- the input projection ``x @ Wx + b`` for *all* timesteps is hoisted out of
+  the recurrence into one large batched matmul (MXU-friendly — at the
+  model's operating point the folded batch is ``B*N`` rows, e.g. 1856 for
+  the reference config, SURVEY.md §3.2), leaving only the ``h @ Wh``
+  recurrent matmul inside the scan;
+- the time loop is a ``lax.scan`` (compiler-friendly, no Python unrolling);
+- ``remat=True`` wraps the scan body in ``jax.checkpoint`` so long-horizon
+  configs (BASELINE config 5, 24-step) trade recompute for activation
+  memory.
+
+Gate math matches torch's LSTM cell definition (i, f, g, o ordering;
+sigmoid/tanh) so state semantics are comparable. Parameters use torch's
+``U(-1/sqrt(H), 1/sqrt(H))`` init; the two bias vectors torch carries
+(``b_ih``, ``b_hh``) are a single fused ``b`` here — identical function
+class, one fewer add per step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["StackedLSTM"]
+
+
+def _uniform_init(scale: float):
+    def init(key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+
+    return init
+
+
+class StackedLSTM(nn.Module):
+    """``num_layers`` stacked LSTMs over a ``(B, T, F)`` sequence.
+
+    Returns ``(outputs, final_states)`` where ``outputs`` is the top layer's
+    ``(B, T, H)`` hidden sequence and ``final_states`` is a list of
+    ``(h, c)`` pairs per layer. Hidden state starts at zero each call unless
+    ``initial_states`` is given (zero-init per forward is the reference's
+    behavior, ``STMGCN.py:53-57``).
+    """
+
+    hidden_dim: int
+    num_layers: int = 1
+    remat: bool = False
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        initial_states: Optional[list] = None,
+    ) -> tuple[jnp.ndarray, list]:
+        batch = x.shape[0]
+        h_dim = self.hidden_dim
+        scale = 1.0 / math.sqrt(h_dim)
+        final_states = []
+        inputs = x
+        for layer in range(self.num_layers):
+            in_dim = inputs.shape[-1]
+            wx = self.param(
+                f"wx_{layer}", _uniform_init(scale), (in_dim, 4 * h_dim), self.param_dtype
+            )
+            wh = self.param(
+                f"wh_{layer}", _uniform_init(scale), (h_dim, 4 * h_dim), self.param_dtype
+            )
+            b = self.param(f"b_{layer}", _uniform_init(scale), (4 * h_dim,), self.param_dtype)
+            inputs, wx, wh, b = nn.dtypes.promote_dtype(inputs, wx, wh, b, dtype=self.dtype)
+
+            # Hoisted input projection: one (B, T, 4H) matmul outside the scan.
+            x_proj = inputs @ wx + b
+
+            if initial_states is not None:
+                h0, c0 = initial_states[layer]
+            else:
+                h0 = jnp.zeros((batch, h_dim), x_proj.dtype)
+                c0 = jnp.zeros((batch, h_dim), x_proj.dtype)
+
+            def step(carry, xt, wh=wh):
+                h, c = carry
+                gates = xt + h @ wh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i = jax.nn.sigmoid(i)
+                f = jax.nn.sigmoid(f)
+                g = jnp.tanh(g)
+                o = jax.nn.sigmoid(o)
+                c = f * c + i * g
+                h = o * jnp.tanh(c)
+                return (h, c), h
+
+            if self.remat:
+                step = jax.checkpoint(step)
+
+            (h_t, c_t), hs = jax.lax.scan(step, (h0, c0), x_proj.swapaxes(0, 1))
+            inputs = hs.swapaxes(0, 1)  # (B, T, H)
+            final_states.append((h_t, c_t))
+        return inputs, final_states

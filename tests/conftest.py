@@ -18,3 +18,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
+
+# The axon TPU plugin in this image ignores the JAX_PLATFORMS env var; the
+# config flag does stick. Must run before any backend initialization.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

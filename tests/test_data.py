@@ -120,7 +120,7 @@ class TestSplits:
 
     def test_descending_dates_raise(self):
         with pytest.raises(ValueError, match="ascending"):
-            date_splits(["0630", "0101", "0701", "0731"])
+            date_splits(["0630", "0101", "0701", "0731"], burn_in=168)
 
     def test_fraction_splits(self):
         spec = fraction_splits(100, train=0.7, validate=0.1)

@@ -1,0 +1,123 @@
+"""Command line entry point.
+
+Supersedes the reference's ``Main.py`` argparse (``Main.py:21-34``): same
+user-facing knobs (``-date``, ``-cpt``, data path, loss) plus preset
+selection for the five baseline configs and full hyperparameter override.
+No ``-device`` flag — JAX owns device selection, and multi-device execution
+is a mesh config, not a flag.
+
+Usage::
+
+    python -m stmgcn_tpu.cli --preset smoke
+    python -m stmgcn_tpu.cli --preset default --data ./data/data_dict.npz \
+        -date 0101 0630 0701 0731 -cpt 3 1 1
+    python -m stmgcn_tpu.cli --preset default --test-only --out-dir output
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from stmgcn_tpu.config import PRESETS, preset
+
+__all__ = ["build_parser", "main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="stmgcn",
+        description="TPU-native ST-MGCN: spatiotemporal multi-graph demand forecasting",
+    )
+    p.add_argument("--preset", choices=sorted(PRESETS), default="default",
+                   help="baseline config to start from")
+    p.add_argument("--data", type=str, default=None,
+                   help="path to a data_dict.npz archive (default: synthetic)")
+    p.add_argument("-date", "--dates", type=str, nargs=4, default=None,
+                   metavar=("TRAIN_S", "TRAIN_E", "TEST_S", "TEST_E"),
+                   help="MMDD split dates, e.g. -date 0101 0630 0701 0731")
+    p.add_argument("-cpt", "--obs-len", type=int, nargs=3, default=None,
+                   metavar=("SERIAL", "DAILY", "WEEKLY"),
+                   help="observation window lengths, e.g. -cpt 3 1 1")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--weight-decay", type=float, default=None)
+    p.add_argument("--loss", choices=("mse", "mae", "huber"), default=None)
+    p.add_argument("--patience", type=int, default=None)
+    p.add_argument("--shuffle", action="store_true", default=None,
+                   help="shuffle training batches (reference default is off)")
+    p.add_argument("--m-graphs", type=int, default=None)
+    p.add_argument("--kernel", choices=("chebyshev", "localpool", "random_walk_diffusion"),
+                   default=None)
+    p.add_argument("--cheb-k", type=int, default=None, help="max polynomial order K")
+    p.add_argument("--dtype", choices=("float32", "bfloat16"), default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--out-dir", type=str, default=None)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from <out-dir>/latest.ckpt before training")
+    p.add_argument("--test-only", action="store_true",
+                   help="skip training; evaluate <out-dir>/best.ckpt")
+    p.add_argument("--print-config", action="store_true",
+                   help="print the resolved config as JSON and exit")
+    return p
+
+
+def config_from_args(args) -> "ExperimentConfig":
+    cfg = preset(args.preset)
+    if args.data is not None:
+        cfg.data.path = args.data
+    if args.dates is not None:
+        cfg.data.dates = tuple(args.dates)
+    if args.obs_len is not None:
+        cfg.data.serial_len, cfg.data.daily_len, cfg.data.weekly_len = args.obs_len
+    for field, attr in [
+        ("epochs", "epochs"), ("batch_size", "batch_size"), ("lr", "lr"),
+        ("weight_decay", "weight_decay"), ("loss", "loss"),
+        ("patience", "patience"), ("seed", "seed"), ("out_dir", "out_dir"),
+    ]:
+        val = getattr(args, field)
+        if val is not None:
+            setattr(cfg.train, attr, val)
+    if args.shuffle:
+        cfg.train.shuffle = True
+    if args.m_graphs is not None:
+        cfg.model.m_graphs = args.m_graphs
+    if args.kernel is not None:
+        cfg.model.kernel_type = args.kernel
+    if args.cheb_k is not None:
+        cfg.model.K = args.cheb_k
+    if args.dtype is not None:
+        cfg.model.dtype = args.dtype
+    return cfg
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    if args.print_config:
+        print(json.dumps(cfg.to_dict(), indent=2))
+        return 0
+
+    from stmgcn_tpu.experiment import build_trainer  # defer heavy imports
+
+    try:
+        trainer = build_trainer(cfg)
+        if args.resume:
+            meta = trainer.restore()
+            print(f"Resumed from epoch {meta['epoch']} (best val {meta['best_val']:.5})")
+        if not args.test_only:
+            trainer.train()
+        results = trainer.test(modes=("train", "test"))
+    except FileNotFoundError as e:
+        print(f"error: {e.filename or e} not found"
+              + (" — train first or check --out-dir" if args.test_only or args.resume else ""),
+              file=sys.stderr)
+        return 1
+    print(json.dumps({"preset": cfg.name, "results": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Typed experiment configuration with presets for the baseline configs.
+
+Replaces the reference's split-brain configuration — module-level constants
+(``Main.py:9-16``), argparse flags (``Main.py:21-34``), and hard-coded model
+widths at the construction site including ``n_nodes=58``
+(``Main.py:62-63``) — with one dataclass tree. ``n_nodes`` is always derived
+from data, never configured (SURVEY.md §5.f).
+
+``PRESETS`` carries the five driver-defined benchmark configs
+(``BASELINE.json``): smoke, default, scaled, multicity, longhorizon.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from stmgcn_tpu.ops.graph import SupportConfig, support_count
+
+__all__ = [
+    "DataConfig",
+    "ExperimentConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "PRESETS",
+    "TrainConfig",
+    "preset",
+]
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Data source + windowing. ``path=None`` generates synthetic data."""
+
+    path: Optional[str] = None
+    rows: int = 10
+    cols: Optional[int] = None
+    n_timesteps: int = 24 * 7 * 8
+    n_cities: int = 1  # >1: samples from several same-shape cities, concatenated
+    dt: int = 1  # hours per timestep (Main.py:10)
+    serial_len: int = 3
+    daily_len: int = 1
+    weekly_len: int = 1
+    dates: Optional[tuple] = None  # (train_s, train_e, test_s, test_e) MMDD
+    val_ratio: float = 0.2
+    year: int = 2017
+    train_frac: float = 0.7  # used when dates is None
+    val_frac: float = 0.1
+    seed: int = 0
+
+    @property
+    def day_timesteps(self) -> int:
+        return 24 // self.dt
+
+    @property
+    def seq_len(self) -> int:
+        return self.serial_len + self.daily_len + self.weekly_len
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Architecture; widths default to the reference's (``Main.py:62-63``)."""
+
+    m_graphs: int = 3
+    kernel_type: str = "chebyshev"
+    K: int = 2
+    bidirectional: bool = True
+    lstm_hidden_dim: int = 64
+    lstm_num_layers: int = 3
+    gcn_hidden_dim: int = 64
+    use_bias: bool = True
+    shared_gate_fc: bool = True
+    remat: bool = False
+    dtype: str = "float32"
+
+    @property
+    def n_supports(self) -> int:
+        return support_count(self.kernel_type, self.K, self.bidirectional)
+
+    @property
+    def support_config(self) -> SupportConfig:
+        return SupportConfig(self.kernel_type, self.K, self.bidirectional)
+
+    @property
+    def compute_dtype(self):
+        return DTYPES[self.dtype]
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Optimization recipe; defaults are the reference's (``Main.py:9-16``)."""
+
+    epochs: int = 100
+    batch_size: int = 32
+    lr: float = 2e-3
+    weight_decay: float = 1e-4
+    loss: str = "mse"
+    patience: int = 10
+    shuffle: bool = False  # reference parity (Data_Container.py:122)
+    seed: int = 0
+    out_dir: str = "output"
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Device mesh extents: data-parallel x region(model)-parallel shards."""
+
+    dp: int = 1
+    region: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.region
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    name: str = "default"
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExperimentConfig":
+        return cls(
+            name=d.get("name", "default"),
+            data=DataConfig(**d.get("data", {})),
+            model=ModelConfig(**d.get("model", {})),
+            train=TrainConfig(**d.get("train", {})),
+            mesh=MeshConfig(**d.get("mesh", {})),
+        )
+
+
+def _smoke() -> ExperimentConfig:
+    """BASELINE config 1: single neighborhood-graph ChebGCN, 10x10 grid."""
+    return ExperimentConfig(
+        name="smoke",
+        data=DataConfig(rows=10, n_timesteps=24 * 7 * 4),
+        model=ModelConfig(m_graphs=1, lstm_hidden_dim=32, lstm_num_layers=1,
+                          gcn_hidden_dim=32),
+        train=TrainConfig(epochs=5, batch_size=32),
+    )
+
+
+def _default() -> ExperimentConfig:
+    """BASELINE config 2: full ST-MGCN, 3 graphs + CGRNN."""
+    return ExperimentConfig(name="default", data=DataConfig(rows=10))
+
+
+def _scaled() -> ExperimentConfig:
+    """BASELINE config 3: 50x50 grid, K=3, region axis sharded."""
+    return ExperimentConfig(
+        name="scaled",
+        data=DataConfig(rows=50, n_timesteps=24 * 7 * 4),
+        model=ModelConfig(K=3, dtype="bfloat16"),
+        train=TrainConfig(batch_size=16),
+        mesh=MeshConfig(region=8),
+    )
+
+
+def _multicity() -> ExperimentConfig:
+    """BASELINE config 4: multi-city batches, data-parallel mesh."""
+    return ExperimentConfig(
+        name="multicity",
+        data=DataConfig(rows=12, n_cities=2, n_timesteps=24 * 7 * 4),
+        train=TrainConfig(batch_size=64),
+        mesh=MeshConfig(dp=8),
+    )
+
+
+def _longhorizon() -> ExperimentConfig:
+    """BASELINE config 5: 24-step history, rematerialized scan."""
+    return ExperimentConfig(
+        name="longhorizon",
+        data=DataConfig(rows=10, serial_len=24, n_timesteps=24 * 7 * 6),
+        model=ModelConfig(remat=True),
+    )
+
+
+PRESETS = {
+    "smoke": _smoke,
+    "default": _default,
+    "scaled": _scaled,
+    "multicity": _multicity,
+    "longhorizon": _longhorizon,
+}
+
+
+def preset(name: str) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise ValueError(f"preset must be one of {sorted(PRESETS)}, got {name!r}")
+    return PRESETS[name]()

@@ -4,7 +4,9 @@ Counterpart of the reference's ``DataGenerator.get_data_loader`` +
 ``TaxiDataset`` (``Data_Container.py:54-123``), redesigned for TPU:
 
 - windows are built once, vectorized, on the host (float32 numpy);
-- splits are *views* into the sample arrays (no per-mode copies);
+- splits are computed per city and the per-mode slices of every city are
+  concatenated, so multi-city training (BASELINE config 4) sees both
+  cities in every mode rather than one city leaking entirely into test;
 - batching yields host numpy — device placement is the trainer's decision
   (``jax.device_put`` once for small configs, sharded placement for meshes)
   rather than an eager ``.to(device)`` inside the dataset
@@ -19,7 +21,7 @@ Reference parity defaults: min-max normalization over the full tensor,
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterator
+from typing import Iterator, Sequence, Union
 
 import numpy as np
 
@@ -45,44 +47,92 @@ class Batch:
 
 
 class DemandDataset:
-    """Windowed, normalized, split demand samples with batch iteration."""
+    """Windowed, normalized, split demand samples with batch iteration.
+
+    ``data`` may be a single :class:`DemandData` or a sequence of
+    same-shape cities; windows never cross city boundaries, and each mode's
+    samples are the concatenation of that mode's slice from every city.
+    """
 
     def __init__(
         self,
-        data: DemandData,
+        data: Union[DemandData, Sequence[DemandData]],
         window: WindowSpec,
         split: SplitSpec | None = None,
         normalize: bool = True,
     ):
+        datas = list(data) if isinstance(data, (list, tuple)) else [data]
+        if not datas:
+            raise ValueError("need at least one city")
+        shapes = {d.demand.shape for d in datas}
+        if len(shapes) != 1:
+            raise ValueError(f"cities must share (T, N, C) shape, got {shapes}")
+        for d in datas[1:]:
+            if list(d.adjs) != list(datas[0].adjs) or any(
+                not np.array_equal(d.adjs[k], datas[0].adjs[k]) for k in d.adjs
+            ):
+                raise ValueError(
+                    "multi-city training uses one support stack, so all cities "
+                    "must share identical adjacency graphs; got differing graphs "
+                    "(build the cities over a common region structure)"
+                )
         self.window = window
-        self.normalizer = MinMaxNormalizer.fit(data.demand) if normalize else None
-        demand = (
-            self.normalizer.transform(data.demand) if normalize else data.demand
-        ).astype(np.float32)
-        self.x, self.y = sliding_windows(demand, window)
+        self.n_cities = len(datas)
+        self.adjs = datas[0].adjs
+        self._mode_cache: dict = {}
+
+        stacked = np.concatenate([d.demand for d in datas], axis=0)
+        self.normalizer = MinMaxNormalizer.fit(stacked) if normalize else None
+
+        self._xs, self._ys = [], []
+        for d in datas:
+            demand = (
+                self.normalizer.transform(d.demand) if normalize else d.demand
+            ).astype(np.float32)
+            x, y = sliding_windows(demand, window)
+            self._xs.append(x)
+            self._ys.append(y)
+
+        per_city = self._ys[0].shape[0]
         self.split = (
-            split.validate_against(self.n_samples)
+            split.validate_against(per_city)
             if split is not None
-            else fraction_splits(self.n_samples)
+            else fraction_splits(per_city)
         )
-        self.adjs = data.adjs
+
+    @property
+    def samples_per_city(self) -> int:
+        return self._ys[0].shape[0]
 
     @property
     def n_samples(self) -> int:
-        return self.y.shape[0]
+        return self.samples_per_city * self.n_cities
 
     @property
     def n_nodes(self) -> int:
-        return self.y.shape[1]
+        return self._ys[0].shape[1]
 
     @property
     def n_feats(self) -> int:
-        return self.y.shape[2]
+        return self._ys[0].shape[2]
+
+    def mode_size(self, mode: str) -> int:
+        """Total samples for a mode across all cities."""
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        return self.split.mode_len[mode] * self.n_cities
 
     def arrays(self, mode: str) -> tuple[np.ndarray, np.ndarray]:
-        """Full ``(x, y)`` views for a mode (no copy)."""
+        """Full ``(x, y)`` for a mode — a view for one city, a cached concat otherwise."""
         start, stop = self.split.range_for(mode)
-        return self.x[start:stop], self.y[start:stop]
+        if self.n_cities == 1:
+            return self._xs[0][start:stop], self._ys[0][start:stop]
+        if mode not in self._mode_cache:
+            self._mode_cache[mode] = (
+                np.concatenate([x[start:stop] for x in self._xs], axis=0),
+                np.concatenate([y[start:stop] for y in self._ys], axis=0),
+            )
+        return self._mode_cache[mode]
 
     def denormalize(self, values):
         if self.normalizer is None:
@@ -90,7 +140,7 @@ class DemandDataset:
         return self.normalizer.inverse(values)
 
     def num_batches(self, mode: str, batch_size: int, drop_last: bool = False) -> int:
-        n = self.split.mode_len[mode]
+        n = self.mode_size(mode)
         return n // batch_size if drop_last else -(-n // batch_size)
 
     def batches(
@@ -111,8 +161,6 @@ class DemandDataset:
         the loss/metrics mask the padding. ``shuffle`` reshuffles per epoch
         with a deterministic ``(seed, epoch)`` stream.
         """
-        if mode not in MODES:
-            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if drop_last and pad_last:
             raise ValueError("drop_last and pad_last are mutually exclusive")
         x, y = self.arrays(mode)

@@ -1,0 +1,138 @@
+"""Experiment assembly: config -> data, supports, model, trainer.
+
+The wiring the reference does inline in ``Main.py:36-88`` (load data, build
+per-graph supports, construct model with hard-coded widths, train, test),
+as composable builders. Everything downstream (CLI, bench, graft entry,
+distributed runners) assembles experiments through these functions.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from stmgcn_tpu.config import ExperimentConfig
+from stmgcn_tpu.data import (
+    DemandDataset,
+    WindowSpec,
+    date_splits,
+    load_npz,
+    synthetic_dataset,
+)
+from stmgcn_tpu.data.splits import fraction_splits
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.train import Trainer
+
+__all__ = ["build_dataset", "build_supports", "build_model", "build_trainer", "run"]
+
+
+def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
+    """Load or synthesize demand data and window/split it per config."""
+    d = cfg.data
+    window = WindowSpec(d.serial_len, d.daily_len, d.weekly_len, d.day_timesteps)
+    if d.path is not None:
+        paths = [p for p in d.path.split(",") if p]
+        if d.n_cities > 1 and len(paths) != d.n_cities:
+            raise ValueError(
+                f"n_cities={d.n_cities} needs {d.n_cities} comma-separated "
+                f"archives in data.path, got {len(paths)}"
+            )
+        cities = [load_npz(p, m_graphs=cfg.model.m_graphs) for p in paths]
+    else:
+        cities = [
+            synthetic_dataset(
+                rows=d.rows,
+                cols=d.cols,
+                n_timesteps=d.n_timesteps,
+                m_graphs=cfg.model.m_graphs,
+                day_timesteps=d.day_timesteps,
+                seed=d.seed + c,
+            )
+            for c in range(d.n_cities)
+        ]
+        # One support stack serves all branches, so synthetic cities share the
+        # region-graph structure (distinct demand, common graphs) — the DP
+        # mesh axis is what the multicity config exercises.
+        for c in cities[1:]:
+            c.adjs = cities[0].adjs
+    n_samples = cities[0].demand.shape[0] - window.burn_in
+    if d.dates is not None:
+        split = date_splits(
+            list(d.dates),
+            burn_in=window.burn_in,
+            day_timesteps=d.day_timesteps,
+            val_ratio=d.val_ratio,
+            year=d.year,
+            n_samples=n_samples,
+        )
+    else:
+        split = fraction_splits(n_samples, train=d.train_frac, validate=d.val_frac)
+    return DemandDataset(cities if len(cities) > 1 else cities[0], window, split)
+
+
+def build_supports(cfg: ExperimentConfig, dataset: DemandDataset) -> np.ndarray:
+    """Stacked ``(M, n_supports, N, N)`` supports from the dataset's graphs."""
+    return cfg.model.support_config.build_all(dataset.adjs.values())
+
+
+def build_model(cfg: ExperimentConfig, dataset: DemandDataset) -> STMGCN:
+    m = cfg.model
+    return STMGCN(
+        m_graphs=m.m_graphs,
+        n_supports=m.n_supports,
+        seq_len=cfg.data.seq_len,
+        input_dim=dataset.n_feats,
+        lstm_hidden_dim=m.lstm_hidden_dim,
+        lstm_num_layers=m.lstm_num_layers,
+        gcn_hidden_dim=m.gcn_hidden_dim,
+        use_bias=m.use_bias,
+        shared_gate_fc=m.shared_gate_fc,
+        remat=m.remat,
+        dtype=m.compute_dtype if m.dtype != "float32" else None,
+    )
+
+
+def build_trainer(
+    cfg: ExperimentConfig,
+    shard_fn: Optional[Callable] = None,
+    verbose: bool = True,
+) -> Trainer:
+    if shard_fn is None and cfg.mesh.n_devices > 1:
+        import warnings
+
+        warnings.warn(
+            f"config requests a {cfg.mesh.dp}x{cfg.mesh.region} device mesh but "
+            "no shard_fn was provided; running unsharded on the default device "
+            "(use stmgcn_tpu.parallel to build a sharded trainer)",
+            stacklevel=2,
+        )
+    dataset = build_dataset(cfg)
+    supports = build_supports(cfg, dataset)
+    model = build_model(cfg, dataset)
+    t = cfg.train
+    return Trainer(
+        model,
+        dataset,
+        supports,
+        lr=t.lr,
+        weight_decay=t.weight_decay,
+        loss=t.loss,
+        n_epochs=t.epochs,
+        batch_size=t.batch_size,
+        patience=t.patience,
+        shuffle=t.shuffle,
+        seed=t.seed,
+        out_dir=t.out_dir,
+        shard_fn=shard_fn,
+        extra_meta={"config": cfg.to_dict()},
+        verbose=verbose,
+    )
+
+
+def run(cfg: ExperimentConfig, verbose: bool = True) -> dict:
+    """Train then test (the reference's ``Main.py:78-88`` flow)."""
+    trainer = build_trainer(cfg, verbose=verbose)
+    history = trainer.train()
+    results = trainer.test(modes=("train", "test"))
+    return {"history": history, "results": results}

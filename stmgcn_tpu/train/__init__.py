@@ -1,0 +1,29 @@
+"""Training/eval layer: optimization, jitted steps, checkpointing, metrics.
+
+Counterpart of the reference's ``Model_Trainer.py`` (L4 in SURVEY.md §1),
+rebuilt for JAX: the per-batch work is a single jitted ``train_step`` (grad +
+Adam-with-L2 update) instead of an eager autograd loop, checkpoints are
+self-sufficient single-file pytrees (params + optimizer state + step +
+normalizer statistics), and the best-on-validation / patience early-stop
+semantics match the reference exactly (``Model_Trainer.py:47-60``).
+"""
+
+from stmgcn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+from stmgcn_tpu.train.metrics import MAE, MAPE, MSE, PCC, RMSE, regression_report
+from stmgcn_tpu.train.step import StepFns, make_optimizer, make_step_fns
+from stmgcn_tpu.train.trainer import Trainer
+
+__all__ = [
+    "MAE",
+    "MAPE",
+    "MSE",
+    "PCC",
+    "RMSE",
+    "StepFns",
+    "Trainer",
+    "load_checkpoint",
+    "make_optimizer",
+    "make_step_fns",
+    "regression_report",
+    "save_checkpoint",
+]

@@ -1,0 +1,73 @@
+"""Self-sufficient single-file checkpoints with atomic writes.
+
+The reference saves only ``{'epoch', 'state_dict'}`` on validation
+improvement (``Model_Trainer.py:18,52-53``): optimizer state is lost (no
+true resume) and the normalizer statistics live only on the in-memory
+loader object, so its checkpoints cannot even denormalize predictions
+(SURVEY.md §5.d). Here one file carries everything a preempted TPU job
+needs: model params, optimizer state, and a JSON meta block (step/epoch,
+best validation loss, early-stop counter, normalizer statistics, config).
+
+Format: three length-prefixed blobs — JSON meta, flax-serialized params,
+flax-serialized optimizer state — written to a temp file and ``os.replace``d
+so a preemption mid-write never corrupts the previous checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Optional
+
+from flax import serialization
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+_MAGIC = b"STMG1\n"
+
+
+def save_checkpoint(path: str, params: Any, opt_state: Any, meta: dict) -> None:
+    """Atomically write ``params``/``opt_state``/``meta`` to ``path``."""
+    blobs = [
+        json.dumps(meta).encode("utf-8"),
+        serialization.to_bytes(params),
+        serialization.to_bytes(opt_state),
+    ]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        for blob in blobs:
+            f.write(struct.pack("<Q", len(blob)))
+            f.write(blob)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(
+    path: str,
+    params_template: Optional[Any] = None,
+    opt_state_template: Optional[Any] = None,
+) -> tuple[dict, Any, Any]:
+    """Read ``(meta, params, opt_state)`` back.
+
+    With templates (the freshly-initialized structures), the exact pytree
+    types are restored; without, params/opt_state come back as plain nested
+    dicts — sufficient for ``model.apply`` at inference.
+    """
+    with open(path, "rb") as f:
+        if f.read(len(_MAGIC)) != _MAGIC:
+            raise ValueError(f"{path} is not a stmgcn-tpu checkpoint")
+        blobs = []
+        for _ in range(3):
+            (length,) = struct.unpack("<Q", f.read(8))
+            blobs.append(f.read(length))
+    meta = json.loads(blobs[0].decode("utf-8"))
+    if params_template is not None:
+        params = serialization.from_bytes(params_template, blobs[1])
+    else:
+        params = serialization.msgpack_restore(blobs[1])
+    if opt_state_template is not None:
+        opt_state = serialization.from_bytes(opt_state_template, blobs[2])
+    else:
+        opt_state = serialization.msgpack_restore(blobs[2])
+    return meta, params, opt_state

@@ -1,0 +1,267 @@
+"""Training-layer tests: metrics, optimizer parity, steps, checkpoints, loop."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import ExperimentConfig, preset
+from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+from stmgcn_tpu.experiment import build_dataset, build_supports, build_trainer
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.train import (
+    MAPE,
+    MSE,
+    PCC,
+    RMSE,
+    Trainer,
+    load_checkpoint,
+    make_optimizer,
+    make_step_fns,
+    save_checkpoint,
+    regression_report,
+)
+
+
+class TestMetrics:
+    def test_known_values(self):
+        pred = np.array([1.0, 2.0, 3.0])
+        true = np.array([1.0, 3.0, 5.0])
+        assert MSE(pred, true) == pytest.approx(5.0 / 3.0)
+        assert RMSE(pred, true) == pytest.approx(np.sqrt(5.0 / 3.0))
+
+    def test_mape_epsilon_guard(self):
+        # reference: |err| / (y + 1.0) (Model_Trainer.py:110)
+        pred = np.array([1.0])
+        true = np.array([0.0])
+        assert MAPE(pred, true) == pytest.approx(1.0)
+
+    def test_pcc_perfect(self):
+        x = np.arange(10.0)
+        assert PCC(2 * x + 1, x) == pytest.approx(1.0)
+
+    def test_report_keys(self):
+        r = regression_report(np.ones(4), np.ones(4) * 2)
+        assert set(r) == {"mse", "rmse", "mae", "mape", "pcc"}
+
+
+class TestOptimizerParity:
+    def test_matches_torch_adam_with_l2(self):
+        """optax chain == torch.optim.Adam(lr, weight_decay=wd) over 5 steps."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(0)
+        w0 = rng.standard_normal((4, 3)).astype(np.float32)
+        grads = [rng.standard_normal((4, 3)).astype(np.float32) for _ in range(5)]
+        lr, wd = 2e-3, 1e-4
+
+        p = torch.nn.Parameter(torch.tensor(w0.copy()))
+        opt = torch.optim.Adam([p], lr=lr, weight_decay=wd)
+        for g in grads:
+            opt.zero_grad()
+            p.grad = torch.tensor(g)
+            opt.step()
+        want = p.detach().numpy()
+
+        tx = make_optimizer(lr, wd)
+        params = {"w": jnp.asarray(w0)}
+        state = tx.init(params)
+        for g in grads:
+            updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+        np.testing.assert_allclose(np.asarray(params["w"]), want, rtol=1e-5, atol=1e-6)
+
+
+def tiny_setup(seed=0, M=2, N=9, T=5, B=8):
+    rng = np.random.default_rng(seed)
+    sup = jnp.asarray(rng.standard_normal((M, 3, N, N)).astype(np.float32) * 0.2)
+    model = STMGCN(m_graphs=M, n_supports=3, seq_len=T, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8)
+    x = jnp.asarray(rng.standard_normal((B, T, N, 1)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((B, N, 1)).astype(np.float32) * 0.1)
+    return model, sup, x, y
+
+
+class TestStepFns:
+    def test_masked_loss_equals_ragged(self):
+        model, sup, x, y = tiny_setup()
+        fns = make_step_fns(model, make_optimizer(1e-3), "mse")
+        params, _ = fns.init(jax.random.key(0), sup, x)
+        # full batch of 8, but only 5 real samples
+        mask = jnp.asarray((np.arange(8) < 5).astype(np.float32))
+        loss_masked, _ = fns.eval_step(params, sup, x, y, mask)
+        loss_ragged, _ = fns.eval_step(params, sup, x[:5], y[:5], jnp.ones(5))
+        np.testing.assert_allclose(float(loss_masked), float(loss_ragged), rtol=1e-6)
+
+    def test_training_reduces_loss(self):
+        model, sup, x, y = tiny_setup()
+        fns = make_step_fns(model, make_optimizer(1e-2), "mse")
+        params, opt_state = fns.init(jax.random.key(0), sup, x)
+        mask = jnp.ones(x.shape[0])
+        first = None
+        for i in range(30):
+            params, opt_state, loss = fns.train_step(params, opt_state, sup, x, y, mask)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first * 0.5
+
+    @pytest.mark.parametrize("loss", ["mse", "mae", "huber"])
+    def test_loss_kinds(self, loss):
+        model, sup, x, y = tiny_setup()
+        fns = make_step_fns(model, make_optimizer(1e-3), loss)
+        params, opt_state = fns.init(jax.random.key(0), sup, x)
+        _, _, val = fns.train_step(params, opt_state, sup, x, y, jnp.ones(x.shape[0]))
+        assert np.isfinite(float(val))
+
+    def test_unknown_loss_raises(self):
+        model, *_ = tiny_setup()
+        with pytest.raises(ValueError, match="loss"):
+            make_step_fns(model, make_optimizer(1e-3), "nll")
+
+
+class TestCheckpoint:
+    def test_roundtrip_with_templates(self, tmp_path):
+        model, sup, x, y = tiny_setup()
+        fns = make_step_fns(model, make_optimizer(1e-3, 1e-4), "mse")
+        params, opt_state = fns.init(jax.random.key(0), sup, x)
+        params2, opt_state2, _ = fns.train_step(params, opt_state, sup, x, y,
+                                                jnp.ones(x.shape[0]))
+        path = str(tmp_path / "t.ckpt")
+        meta = {"epoch": 3, "best_val": 0.5, "normalizer": {"kind": "minmax",
+                "minimum": 0.0, "maximum": 9.0}}
+        save_checkpoint(path, params2, opt_state2, meta)
+        meta_l, params_l, opt_l = load_checkpoint(path, params, opt_state)
+        assert meta_l == meta
+        jax.tree.map(np.testing.assert_array_equal, params_l, params2)
+        jax.tree.map(
+            np.testing.assert_array_equal,
+            jax.tree.leaves(opt_l), jax.tree.leaves(opt_state2),
+        )
+
+    def test_load_without_templates(self, tmp_path):
+        model, sup, x, _ = tiny_setup()
+        fns = make_step_fns(model, make_optimizer(1e-3), "mse")
+        params, opt_state = fns.init(jax.random.key(0), sup, x)
+        path = str(tmp_path / "t.ckpt")
+        save_checkpoint(path, params, opt_state, {"epoch": 1})
+        _, params_l, _ = load_checkpoint(path)
+        out_a = model.apply(params, sup, x)
+        out_b = model.apply(jax.tree.map(jnp.asarray, params_l), sup, x)
+        np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=1e-6)
+
+    def test_bad_magic_raises(self, tmp_path):
+        path = tmp_path / "junk.ckpt"
+        path.write_bytes(b"not a checkpoint")
+        with pytest.raises(ValueError, match="not a stmgcn-tpu checkpoint"):
+            load_checkpoint(str(path))
+
+
+def small_trainer(tmp_path, epochs=3, patience=10, **model_kw):
+    data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 60, seed=1)
+    dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    from stmgcn_tpu.ops import SupportConfig
+
+    sup = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8, **model_kw)
+    return Trainer(model, dataset, sup, n_epochs=epochs, patience=patience,
+                   batch_size=16, out_dir=str(tmp_path), verbose=False)
+
+
+class TestTrainer:
+    def test_train_writes_history_and_checkpoints(self, tmp_path):
+        tr = small_trainer(tmp_path, epochs=2)
+        hist = tr.train()
+        assert len(hist["train"]) == 2
+        assert os.path.exists(tr.best_path) and os.path.exists(tr.latest_path)
+        lines = [json.loads(l) for l in open(tmp_path / "history.jsonl")]
+        assert [l["epoch"] for l in lines] == [1, 2]
+        meta, _, _ = load_checkpoint(tr.best_path)
+        assert meta["normalizer"]["kind"] == "minmax"
+
+    def test_early_stopping_patience(self, tmp_path, monkeypatch):
+        tr = small_trainer(tmp_path, epochs=50, patience=2)
+        # scripted losses: improves once, then never again
+        script = iter([1.0, 0.5, 1.0, 0.9, 1.0, 0.8, 1.0, 0.7, 1.0, 0.6])
+        monkeypatch.setattr(tr, "_run_epoch", lambda mode, train: next(script))
+        tr.train()
+        assert tr.epoch == 3  # epoch1 improve; epochs 2,3 fail -> patience 2 exhausted
+        assert tr.best_val == 0.5
+
+    def test_patience_resets_on_improvement(self, tmp_path, monkeypatch):
+        tr = small_trainer(tmp_path, epochs=50, patience=2)
+        script = iter([1.0, 0.5, 1.0, 0.6, 1.0, 0.4, 1.0, 0.5, 1.0, 0.45, 1.0, 0.41])
+        monkeypatch.setattr(tr, "_run_epoch", lambda mode, train: next(script))
+        tr.train()
+        # improvements at epochs 1 and 3 reset patience; epochs 4,5 fail -> stop at 5
+        assert tr.epoch == 5
+        assert tr.best_val == 0.4
+
+    def test_resume_continues_epoch_count(self, tmp_path):
+        tr = small_trainer(tmp_path, epochs=2)
+        tr.train()
+        tr2 = small_trainer(tmp_path, epochs=4)
+        meta = tr2.restore()
+        assert meta["epoch"] == 2
+        hist = tr2.train()
+        assert len(hist["train"]) == 2  # epochs 3 and 4 only
+        assert tr2.epoch == 4
+
+    def test_test_reports_denormalized_metrics(self, tmp_path):
+        tr = small_trainer(tmp_path, epochs=1)
+        tr.train()
+        res = tr.test(modes=("test",))
+        assert set(res["test"]) == {"mse", "rmse", "mae", "mape", "pcc"}
+        # denormalized scale: RMSE should be in raw demand units (>> normalized 2-range)
+        assert res["test"]["rmse"] > 1.0
+
+
+class TestConfigAndExperiment:
+    def test_presets_build(self):
+        for name in ("smoke", "default", "scaled", "multicity", "longhorizon"):
+            cfg = preset(name)
+            assert cfg.name == name
+            assert ExperimentConfig.from_dict(cfg.to_dict()).to_dict() == cfg.to_dict()
+
+    def test_unknown_preset_raises(self):
+        with pytest.raises(ValueError):
+            preset("nope")
+
+    def test_build_dataset_multicity(self):
+        cfg = preset("multicity")
+        cfg.data.n_timesteps = 24 * 7 * 2 + 48
+        ds = build_dataset(cfg)
+        assert ds.n_cities == 2
+        assert ds.mode_size("train") == ds.split.mode_len["train"] * 2
+        x, y = ds.arrays("train")
+        assert x.shape[0] == ds.mode_size("train")
+
+    def test_build_trainer_smoke_config(self, tmp_path):
+        cfg = preset("smoke")
+        cfg.data.n_timesteps = 24 * 7 * 2 + 48
+        cfg.train.epochs = 1
+        cfg.train.out_dir = str(tmp_path)
+        tr = build_trainer(cfg, verbose=False)
+        hist = tr.train()
+        assert len(hist["train"]) == 1
+
+    def test_supports_shape_from_config(self):
+        cfg = preset("smoke")
+        cfg.data.n_timesteps = 24 * 7 * 2 + 48
+        ds = build_dataset(cfg)
+        sup = build_supports(cfg, ds)
+        assert sup.shape == (1, 3, 100, 100)
+
+    def test_cli_overrides(self):
+        from stmgcn_tpu.cli import build_parser, config_from_args
+
+        args = build_parser().parse_args(
+            ["--preset", "smoke", "--epochs", "7", "--lr", "0.01",
+             "-cpt", "6", "2", "1", "--kernel", "localpool", "--cheb-k", "1"]
+        )
+        cfg = config_from_args(args)
+        assert cfg.train.epochs == 7 and cfg.train.lr == 0.01
+        assert (cfg.data.serial_len, cfg.data.daily_len, cfg.data.weekly_len) == (6, 2, 1)
+        assert cfg.model.kernel_type == "localpool" and cfg.model.K == 1

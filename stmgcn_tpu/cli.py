@@ -55,6 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--out-dir", type=str, default=None)
+    p.add_argument("--rows", type=int, default=None,
+                   help="synthetic city grid rows (N = rows^2)")
+    p.add_argument("--timesteps", type=int, default=None,
+                   help="synthetic demand length in timesteps")
+    p.add_argument("--platform", choices=("tpu", "cpu"), default=None,
+                   help="force a JAX platform (default: auto)")
+    p.add_argument("--virtual-devices", type=int, default=None, metavar="N",
+                   help="emulate N devices on CPU (for mesh dry-runs; implies "
+                        "--platform cpu)")
     p.add_argument("--resume", action="store_true",
                    help="resume from <out-dir>/latest.ckpt before training")
     p.add_argument("--test-only", action="store_true",
@@ -72,6 +81,10 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.data.dates = tuple(args.dates)
     if args.obs_len is not None:
         cfg.data.serial_len, cfg.data.daily_len, cfg.data.weekly_len = args.obs_len
+    if args.rows is not None:
+        cfg.data.rows = args.rows
+    if args.timesteps is not None:
+        cfg.data.n_timesteps = args.timesteps
     for field, attr in [
         ("epochs", "epochs"), ("batch_size", "batch_size"), ("lr", "lr"),
         ("weight_decay", "weight_decay"), ("loss", "loss"),
@@ -100,10 +113,30 @@ def main(argv=None) -> int:
         print(json.dumps(cfg.to_dict(), indent=2))
         return 0
 
+    # Platform selection must land before the JAX backend initializes (no
+    # jax array op has run yet at this point).
+    if args.virtual_devices:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.virtual_devices}"
+        ).strip()
+        args.platform = args.platform or "cpu"
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
     from stmgcn_tpu.experiment import build_trainer  # defer heavy imports
 
     try:
         trainer = build_trainer(cfg)
+    except ValueError as e:
+        # configuration errors (mesh size, divisibility, splits) — no traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    try:
         if args.resume:
             meta = trainer.restore()
             print(f"Resumed from epoch {meta['epoch']} (best val {meta['best_val']:.5})")

@@ -8,8 +8,6 @@ distributed runners) assembles experiments through these functions.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
-
 import numpy as np
 
 from stmgcn_tpu.config import ExperimentConfig
@@ -95,21 +93,25 @@ def build_model(cfg: ExperimentConfig, dataset: DemandDataset) -> STMGCN:
 
 def build_trainer(
     cfg: ExperimentConfig,
-    shard_fn: Optional[Callable] = None,
+    placement=None,
     verbose: bool = True,
 ) -> Trainer:
-    if shard_fn is None and cfg.mesh.n_devices > 1:
-        import warnings
+    """Assemble a trainer; a >1-device mesh config gets sharded placement.
 
-        warnings.warn(
-            f"config requests a {cfg.mesh.dp}x{cfg.mesh.region} device mesh but "
-            "no shard_fn was provided; running unsharded on the default device "
-            "(use stmgcn_tpu.parallel to build a sharded trainer)",
-            stacklevel=2,
-        )
+    If the config asks for a mesh and fewer devices are visible, this
+    raises — silent fallback to one device would misreport the benchmark
+    configs (3/4) as sharded.
+    """
+    if placement is None and cfg.mesh.n_devices > 1:
+        # Fail fast (before data/support construction) if the mesh can't exist.
+        from stmgcn_tpu.parallel import MeshPlacement, mesh_from_config
+
+        placement = MeshPlacement(mesh_from_config(cfg.mesh))
     dataset = build_dataset(cfg)
     supports = build_supports(cfg, dataset)
     model = build_model(cfg, dataset)
+    if placement is not None and hasattr(placement, "check_divisibility"):
+        placement.check_divisibility(cfg.train.batch_size, dataset.n_nodes)
     t = cfg.train
     return Trainer(
         model,
@@ -124,7 +126,7 @@ def build_trainer(
         shuffle=t.shuffle,
         seed=t.seed,
         out_dir=t.out_dir,
-        shard_fn=shard_fn,
+        placement=placement,
         extra_meta={"config": cfg.to_dict()},
         verbose=verbose,
     )

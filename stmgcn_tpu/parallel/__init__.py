@@ -1,0 +1,27 @@
+"""Distributed execution: device meshes, shardings, halo exchange.
+
+The reference has **no** parallelism or communication layer (SURVEY.md §2:
+no torch.distributed/NCCL/MPI anywhere; one device picked by a CLI flag).
+This package is its TPU-native replacement, built on ``jax.sharding``:
+
+- :mod:`mesh` — ``Mesh`` construction over a ``dp x region`` axis grid
+  (data parallelism over the batch, graph-node parallelism over the region
+  axis — the spatial analogue of sequence parallelism for this model).
+- :mod:`placement` — ``NamedSharding`` placement rules for every array kind
+  (params replicated, batch dp-sharded, supports/nodes region-sharded).
+  With inputs placed, ``jit``/GSPMD propagates shardings through the model
+  and inserts the XLA collectives (gradient ``psum`` over dp, node
+  all-gathers over region) that ride ICI — no hand-written NCCL analogue.
+- :mod:`halo` — explicit ``shard_map`` + ``ppermute`` ring halo exchange
+  for banded (grid) graphs, exchanging only boundary nodes instead of
+  all-gathering the full node axis.
+
+Multi-host: the same mesh axes extend over ``jax.distributed``-initialized
+process groups; collectives within a slice ride ICI and across slices DCN.
+"""
+
+from stmgcn_tpu.parallel.halo import halo_exchange
+from stmgcn_tpu.parallel.mesh import build_mesh, mesh_from_config
+from stmgcn_tpu.parallel.placement import MeshPlacement
+
+__all__ = ["MeshPlacement", "build_mesh", "halo_exchange", "mesh_from_config"]

@@ -1,0 +1,47 @@
+"""Ring halo exchange over the region axis (``shard_map`` + ``ppermute``).
+
+For *banded* graphs — grid cities, where node ``i`` only neighbors nodes
+within a fixed index distance ``w`` — a region-sharded graph convolution
+does not need the full-node all-gather GSPMD inserts for dense supports:
+each shard only needs ``w`` boundary rows from its ring neighbors. This
+module provides that exchange as an explicit XLA collective pattern
+(``ppermute`` rides neighbor ICI links; the TPU analogue of the halo
+exchanges in ring attention / stencil codes).
+
+The reference has no counterpart (single device, SURVEY.md §2); this is
+forward-looking infrastructure for the K-hop-partitioned SpMM path
+(SURVEY.md §7 "hard parts" (2)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["halo_exchange"]
+
+
+def halo_exchange(x: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
+    """Pad a node-axis shard with its ring neighbors' boundary rows.
+
+    Must be called inside ``shard_map`` over ``axis_name``. ``x`` is this
+    shard's ``(n_local, ...)`` block of the node axis; returns
+    ``(halo + n_local + halo, ...)`` where the leading rows are the left
+    neighbor's last ``halo`` rows and the trailing rows the right
+    neighbor's first ``halo`` rows. Boundary shards receive zeros
+    (non-periodic — matches a banded adjacency with no wraparound).
+    """
+    if halo <= 0:
+        raise ValueError(f"halo must be positive, got {halo}")
+    if x.shape[0] < halo:
+        raise ValueError(f"shard has {x.shape[0]} rows < halo {halo}")
+    n_shards = jax.lax.axis_size(axis_name)
+    # left halo: shard i receives shard i-1's trailing rows
+    from_left = jax.lax.ppermute(
+        x[-halo:], axis_name, perm=[(i, i + 1) for i in range(n_shards - 1)]
+    )
+    # right halo: shard i receives shard i+1's leading rows
+    from_right = jax.lax.ppermute(
+        x[:halo], axis_name, perm=[(i + 1, i) for i in range(n_shards - 1)]
+    )
+    return jnp.concatenate([from_left, x, from_right], axis=0)

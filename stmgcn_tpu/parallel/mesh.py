@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+A 2-D logical mesh ``(dp, region)``: the ``dp`` axis carries data
+parallelism (batch sharding + gradient all-reduce), the ``region`` axis
+carries graph-node parallelism for large-N configs (BASELINE config 3's
+50x50 grid). On real hardware the mesh should be laid out so ``region``
+(the high-traffic axis: node all-gathers every layer) maps to the faster
+ICI links; ``jax.experimental.mesh_utils`` does this when available.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["build_mesh", "mesh_from_config"]
+
+
+def build_mesh(dp: int = 1, region: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``(dp, region)`` mesh from the first ``dp*region`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    need = dp * region
+    if need < 1:
+        raise ValueError(f"mesh extents must be positive, got dp={dp}, region={region}")
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh needs {need} devices (dp={dp} x region={region}) but only "
+            f"{len(devices)} are visible"
+        )
+    if need > 1:
+        try:  # physical-topology-aware layout on real TPU slices
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh((dp, region), devices=devices[:need])
+        except Exception:
+            arr = np.asarray(devices[:need]).reshape(dp, region)
+    else:
+        arr = np.asarray(devices[:need]).reshape(dp, region)
+    return Mesh(arr, axis_names=("dp", "region"))
+
+
+def mesh_from_config(mesh_cfg, devices: Optional[Sequence] = None) -> Optional[Mesh]:
+    """``MeshConfig -> Mesh``, or ``None`` for the single-device 1x1 case."""
+    if mesh_cfg.n_devices <= 1:
+        return None
+    return build_mesh(mesh_cfg.dp, mesh_cfg.region, devices=devices)

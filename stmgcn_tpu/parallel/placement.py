@@ -1,0 +1,64 @@
+"""Array placement rules over a ``(dp, region)`` mesh.
+
+One object answers "where does this array live": model/optimizer state is
+replicated, batches are split over ``dp``, the graph-node axis over
+``region``. Handing arrays placed this way to the (unchanged) jitted step
+functions is all GSPMD needs — it propagates shardings through the model
+and inserts the collectives (node all-gather in each graph conv, gradient
+``psum`` over dp) automatically. This replaces the communication backend
+the reference never had (SURVEY.md §5.h).
+
+Array-kind conventions (shapes as in the model):
+
+- ``supports`` ``(M, K, N, N)`` — rows (output nodes) sharded:
+  ``P(None, None, 'region', None)``
+- ``x`` ``(B, T, N, C)`` — ``P('dp', None, 'region', None)``
+- ``y`` ``(B, N, C)`` — ``P('dp', 'region', None)``
+- ``mask`` ``(B,)`` — ``P('dp')``
+- ``state`` (params / optimizer) — replicated ``P()``
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MeshPlacement"]
+
+
+class MeshPlacement:
+    """Places arrays onto a mesh by kind; usable as the Trainer's placement."""
+
+    SPECS = {
+        "supports": P(None, None, "region", None),
+        "x": P("dp", None, "region", None),
+        "y": P("dp", "region", None),
+        "mask": P("dp",),
+        "state": P(),
+    }
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def sharding(self, kind: str) -> NamedSharding:
+        if kind not in self.SPECS:
+            raise ValueError(f"unknown array kind {kind!r}; known: {sorted(self.SPECS)}")
+        return NamedSharding(self.mesh, self.SPECS[kind])
+
+    def put(self, tree, kind: str):
+        """Place every array leaf of ``tree`` according to ``kind``.
+
+        Batch axes must divide the mesh extents they shard over (use
+        ``pad_last`` batching for static, divisible batch shapes).
+        """
+        sharding = self.sharding(kind)
+        return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), tree)
+
+    def check_divisibility(self, batch_size: int, n_nodes: int) -> None:
+        dp = self.mesh.shape["dp"]
+        region = self.mesh.shape["region"]
+        if batch_size % dp:
+            raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
+        if n_nodes % region:
+            raise ValueError(f"n_nodes {n_nodes} not divisible by region={region}")

@@ -22,7 +22,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +34,15 @@ from stmgcn_tpu.train.metrics import regression_report
 from stmgcn_tpu.train.step import make_optimizer, make_step_fns
 
 __all__ = ["Trainer"]
+
+
+class _DefaultPlacement:
+    """Single-device placement: plain ``jnp.asarray``; state left in place."""
+
+    def put(self, tree, kind: str):
+        if kind == "state":
+            return tree
+        return jax.tree.map(jnp.asarray, tree)
 
 
 class Trainer:
@@ -54,7 +63,7 @@ class Trainer:
         shuffle: bool = False,
         seed: int = 0,
         out_dir: str = "output",
-        shard_fn: Optional[Callable] = None,
+        placement=None,
         extra_meta: Optional[dict] = None,
         verbose: bool = True,
     ):
@@ -68,9 +77,10 @@ class Trainer:
         self.out_dir = out_dir
         self.verbose = verbose
         self.extra_meta = extra_meta or {}
-        # device placement hook; the parallel layer passes a sharded putter
-        self.shard_fn = shard_fn or jnp.asarray
-        self.supports = self.shard_fn(np.asarray(supports))
+        # device placement hook; stmgcn_tpu.parallel.MeshPlacement shards over
+        # a mesh, the default puts everything on the default device
+        self.placement = placement or _DefaultPlacement()
+        self.supports = self.placement.put(np.asarray(supports), "supports")
 
         for mode in ("train", "validate"):
             if dataset.mode_size(mode) == 0:
@@ -81,8 +91,10 @@ class Trainer:
         self.step_fns = make_step_fns(model, make_optimizer(lr, weight_decay), loss)
         example = next(dataset.batches("train", batch_size, pad_last=True))
         self.params, self.opt_state = self.step_fns.init(
-            jax.random.key(seed), self.supports, self.shard_fn(example.x)
+            jax.random.key(seed), self.supports, self.placement.put(example.x, "x")
         )
+        self.params = self.placement.put(self.params, "state")
+        self.opt_state = self.placement.put(self.opt_state, "state")
 
         self.epoch = 0
         self.best_val = float("inf")
@@ -130,10 +142,10 @@ class Trainer:
             epoch=self.epoch,
             pad_last=True,
         ):
-            x = self.shard_fn(batch.x)
-            y = self.shard_fn(batch.y)
-            mask = self.shard_fn(
-                (np.arange(len(batch)) < batch.n_real).astype(np.float32)
+            x = self.placement.put(batch.x, "x")
+            y = self.placement.put(batch.y, "y")
+            mask = self.placement.put(
+                (np.arange(len(batch)) < batch.n_real).astype(np.float32), "mask"
             )
             if train:
                 self.params, self.opt_state, loss = self.step_fns.train_step(
@@ -196,9 +208,9 @@ class Trainer:
     def restore(self, path: Optional[str] = None) -> dict:
         """Load a checkpoint (default: latest) into the live trainer state."""
         path = path or self.latest_path
-        meta, self.params, self.opt_state = load_checkpoint(
-            path, self.params, self.opt_state
-        )
+        meta, params, opt_state = load_checkpoint(path, self.params, self.opt_state)
+        self.params = self.placement.put(params, "state")
+        self.opt_state = self.placement.put(opt_state, "state")
         self.epoch = meta["epoch"]
         self.best_val = meta["best_val"]
         self.patience_left = meta["patience_left"]
@@ -215,15 +227,16 @@ class Trainer:
         if checkpoint is not None:
             path = self.best_path if checkpoint == "best" else checkpoint
             _, params, _ = load_checkpoint(path, self.params, self.opt_state)
+            params = self.placement.put(params, "state")
         self._log(f"Testing starts at: {time.ctime()}")
         results = {}
         for mode in modes:
             preds, trues = [], []
             for batch in self.dataset.batches(mode, self.batch_size, pad_last=True):
-                x = self.shard_fn(batch.x)
-                y = self.shard_fn(batch.y)
-                mask = self.shard_fn(
-                    (np.arange(len(batch)) < batch.n_real).astype(np.float32)
+                x = self.placement.put(batch.x, "x")
+                y = self.placement.put(batch.y, "y")
+                mask = self.placement.put(
+                    (np.arange(len(batch)) < batch.n_real).astype(np.float32), "mask"
                 )
                 _, pred = self.step_fns.eval_step(params, self.supports, x, y, mask)
                 preds.append(np.asarray(pred)[: batch.n_real])

@@ -1,0 +1,216 @@
+"""Distributed tests on a virtual 8-device CPU mesh (SURVEY.md §4).
+
+Sharded-vs-single-device numerical equality is the correctness contract:
+the same params/batches must produce the same losses and parameter
+trajectories whether run on one device or sharded over (dp, region).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.experiment import build_trainer
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.parallel import MeshPlacement, build_mesh, halo_exchange, mesh_from_config
+from stmgcn_tpu.train import make_optimizer, make_step_fns
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.devices()[:8]
+
+
+def setup_problem(N=16, B=16, M=2, T=5, seed=0):
+    rng = np.random.default_rng(seed)
+    sup = (rng.standard_normal((M, 3, N, N)) * 0.2).astype(np.float32)
+    x = rng.standard_normal((B, T, N, 1)).astype(np.float32)
+    y = (rng.standard_normal((B, N, 1)) * 0.1).astype(np.float32)
+    model = STMGCN(m_graphs=M, n_supports=3, seq_len=T, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=2, gcn_hidden_dim=8)
+    return model, sup, x, y
+
+
+class TestMesh:
+    def test_build_mesh_shape(self, eight_devices):
+        mesh = build_mesh(dp=4, region=2)
+        assert mesh.shape == {"dp": 4, "region": 2}
+
+    def test_too_few_devices(self):
+        with pytest.raises(ValueError, match="devices"):
+            build_mesh(dp=64, region=2)
+
+    def test_mesh_from_config_single_is_none(self):
+        from stmgcn_tpu.config import MeshConfig
+
+        assert mesh_from_config(MeshConfig(dp=1, region=1)) is None
+
+
+class TestShardedEquivalence:
+    @pytest.mark.parametrize("dp,region", [(8, 1), (1, 8), (4, 2)])
+    def test_forward_matches_single_device(self, eight_devices, dp, region):
+        model, sup, x, y = setup_problem()
+        params = model.init(jax.random.key(0), jnp.asarray(sup), jnp.asarray(x))
+        single = np.asarray(jax.jit(model.apply)(params, jnp.asarray(sup), jnp.asarray(x)))
+
+        pl = MeshPlacement(build_mesh(dp=dp, region=region))
+        out = jax.jit(model.apply)(
+            pl.put(params, "state"), pl.put(sup, "supports"), pl.put(x, "x")
+        )
+        np.testing.assert_allclose(np.asarray(out), single, rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("dp,region", [(8, 1), (4, 2)])
+    def test_train_trajectory_matches_single_device(self, eight_devices, dp, region):
+        model, sup, x, y = setup_problem()
+        fns = make_step_fns(model, make_optimizer(1e-2, 1e-4), "mse")
+        mask = np.ones(x.shape[0], np.float32)
+
+        params_s, opt_s = fns.init(jax.random.key(0), jnp.asarray(sup), jnp.asarray(x))
+        ref_params = params_s
+        losses_single = []
+        for _ in range(3):
+            ref_params, opt_s, loss = fns.train_step(
+                ref_params, opt_s, jnp.asarray(sup), jnp.asarray(x),
+                jnp.asarray(y), jnp.asarray(mask),
+            )
+            losses_single.append(float(loss))
+
+        pl = MeshPlacement(build_mesh(dp=dp, region=region))
+        fns2 = make_step_fns(model, make_optimizer(1e-2, 1e-4), "mse")
+        params_m, opt_m = fns2.init(jax.random.key(0), jnp.asarray(sup), jnp.asarray(x))
+        params_m = pl.put(params_m, "state")
+        opt_m = pl.put(opt_m, "state")
+        sup_m, x_m = pl.put(sup, "supports"), pl.put(x, "x")
+        y_m, mask_m = pl.put(y, "y"), pl.put(mask, "mask")
+        losses_mesh = []
+        for _ in range(3):
+            params_m, opt_m, loss = fns2.train_step(params_m, opt_m, sup_m, x_m, y_m, mask_m)
+            losses_mesh.append(float(loss))
+
+        np.testing.assert_allclose(losses_mesh, losses_single, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6
+            ),
+            params_m, ref_params,
+        )
+
+    def test_gradient_allreduce_semantics(self, eight_devices):
+        """dp-sharded batch loss == mean over the full batch, so grads agree."""
+        model, sup, x, y = setup_problem(B=8)
+        fns = make_step_fns(model, make_optimizer(1e-3), "mse")
+        params, _ = fns.init(jax.random.key(1), jnp.asarray(sup), jnp.asarray(x))
+        loss_single, _ = fns.eval_step(
+            params, jnp.asarray(sup), jnp.asarray(x), jnp.asarray(y),
+            jnp.ones(8),
+        )
+        pl = MeshPlacement(build_mesh(dp=8, region=1))
+        loss_mesh, _ = fns.eval_step(
+            pl.put(params, "state"), pl.put(sup, "supports"), pl.put(x, "x"),
+            pl.put(y, "y"), pl.put(np.ones(8, np.float32), "mask"),
+        )
+        np.testing.assert_allclose(float(loss_mesh), float(loss_single), rtol=1e-6)
+
+
+class TestPlacement:
+    def test_divisibility_checks(self, eight_devices):
+        pl = MeshPlacement(build_mesh(dp=4, region=2))
+        pl.check_divisibility(batch_size=16, n_nodes=16)
+        with pytest.raises(ValueError, match="batch_size"):
+            pl.check_divisibility(batch_size=6, n_nodes=16)
+        with pytest.raises(ValueError, match="n_nodes"):
+            pl.check_divisibility(batch_size=16, n_nodes=9)
+
+    def test_unknown_kind_raises(self, eight_devices):
+        pl = MeshPlacement(build_mesh(dp=8, region=1))
+        with pytest.raises(ValueError, match="kind"):
+            pl.put(np.ones(8), "gradients")
+
+    def test_sharding_layout(self, eight_devices):
+        pl = MeshPlacement(build_mesh(dp=2, region=4))
+        x = pl.put(np.zeros((8, 5, 16, 1), np.float32), "x")
+        # 8 shards, each (4, 5, 4, 1)
+        assert len(x.addressable_shards) == 8
+        assert x.addressable_shards[0].data.shape == (4, 5, 4, 1)
+
+
+class TestHaloExchange:
+    def test_matches_unsharded_neighborhood(self, eight_devices):
+        mesh = build_mesh(dp=1, region=8)
+        n, h = 64, 2
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+
+        def padded(local):
+            return halo_exchange(local, halo=h, axis_name="region")
+
+        out = jax.jit(
+            shard_map(padded, mesh=mesh, in_specs=P("region", None),
+                      out_specs=P("region", None))
+        )(x)
+        out = np.asarray(out)  # (8 * (8 + 2h), 3)
+        per = n // 8
+        blocks = out.reshape(8, per + 2 * h, 3)
+        for i in range(8):
+            lo, hi = i * per, (i + 1) * per
+            want_left = x[lo - h : lo] if i > 0 else np.zeros((h, 3))
+            want_right = x[hi : hi + h] if i < 7 else np.zeros((h, 3))
+            np.testing.assert_array_equal(blocks[i, :h], want_left)
+            np.testing.assert_array_equal(blocks[i, h : h + per], x[lo:hi])
+            np.testing.assert_array_equal(blocks[i, h + per :], want_right)
+
+    def test_banded_spmv_via_halo(self, eight_devices):
+        """Banded A @ x computed shard-locally with halos == dense result."""
+        mesh = build_mesh(dp=1, region=8)
+        n, w = 64, 2  # bandwidth w: A[i,j] = 0 for |i-j| > w
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        a[np.abs(np.subtract.outer(np.arange(n), np.arange(n))) > w] = 0.0
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        want = a @ x
+
+        per = n // 8
+
+        def local_spmv(a_rows, x_local):
+            # a_rows: this shard's (per, n) rows; x_local: (per, 4)
+            xp = halo_exchange(x_local, halo=w, axis_name="region")  # (per+2w, 4)
+            i = jax.lax.axis_index("region")
+            # columns this shard's rows can touch: [i*per - w, (i+1)*per + w)
+            cols = jax.lax.dynamic_slice_in_dim(
+                jnp.pad(a_rows, ((0, 0), (w, w))), i * per, per + 2 * w, axis=1
+            )
+            return cols @ xp
+
+        got = jax.jit(
+            shard_map(local_spmv, mesh=mesh,
+                      in_specs=(P("region", None), P("region", None)),
+                      out_specs=P("region", None))
+        )(a, x)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_halo_validation(self, eight_devices):
+        mesh = build_mesh(dp=1, region=8)
+        with pytest.raises(ValueError, match="halo"):
+            jax.jit(
+                shard_map(lambda v: halo_exchange(v, halo=0, axis_name="region"),
+                          mesh=mesh, in_specs=P("region"), out_specs=P("region"))
+            )(np.zeros(16, np.float32))
+
+
+class TestEndToEndShardedTrainer:
+    def test_multicity_preset_trains_on_mesh(self, eight_devices, tmp_path):
+        cfg = preset("multicity")
+        cfg.data.rows = 4  # N=16, divisible by region=1; dp=8 divides batch 64
+        cfg.data.n_timesteps = 24 * 7 * 2 + 24
+        cfg.train.epochs = 1
+        cfg.train.out_dir = str(tmp_path)
+        trainer = build_trainer(cfg, verbose=False)
+        assert isinstance(trainer.placement, MeshPlacement)
+        hist = trainer.train()
+        assert np.isfinite(hist["train"][0])
+        res = trainer.test(modes=("test",))
+        assert np.isfinite(res["test"]["rmse"])

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Benchmark the flagship training step; prints ONE JSON line.
+
+Metric: region-timesteps/sec/chip — ``batch * seq_len * n_nodes`` demand
+points advanced per second of steady-state training step (forward + grad +
+Adam update), on whatever single chip JAX exposes.
+
+``vs_baseline`` compares against the reference-equivalent PyTorch
+implementation's throughput at identical shapes (see
+``benchmarks/torch_baseline.py``; the reference repo itself ships no
+numbers or data — SURVEY.md §6). The stored baseline in
+``benchmarks/baseline.json`` records the hardware it was measured on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+# Benchmark operating point ("Didi-Chengdu, 12-step" scale, BASELINE.json):
+# 16x16 region grid, 12-step observation window, batch 64, full M=3 ST-MGCN.
+# Env overrides (STMGCN_BENCH_*) let the script's logic be validated on
+# slow hosts without changing the canonical TPU operating point.
+ROWS = int(os.environ.get("STMGCN_BENCH_ROWS", 16))
+SERIAL, DAILY, WEEKLY = 10, 1, 1
+BATCH = int(os.environ.get("STMGCN_BENCH_BATCH", 64))
+WARMUP = int(os.environ.get("STMGCN_BENCH_WARMUP", 5))
+ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 30))
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+    from stmgcn_tpu.models import STMGCN
+    from stmgcn_tpu.ops import SupportConfig
+    from stmgcn_tpu.train import make_optimizer, make_step_fns
+
+    seq_len = SERIAL + DAILY + WEEKLY
+    data = synthetic_dataset(rows=ROWS, n_timesteps=24 * 7 * 2 + 4 * BATCH, seed=0)
+    dataset = DemandDataset(data, WindowSpec(SERIAL, DAILY, WEEKLY, 24))
+    supports = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(
+        m_graphs=3,
+        n_supports=3,
+        seq_len=seq_len,
+        input_dim=dataset.n_feats,
+        lstm_hidden_dim=64,
+        lstm_num_layers=3,
+        gcn_hidden_dim=64,
+    )
+    fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
+
+    batch = next(dataset.batches("train", BATCH, pad_last=True))
+    import jax.numpy as jnp
+
+    sup = jnp.asarray(supports)
+    x = jnp.asarray(batch.x)
+    y = jnp.asarray(batch.y)
+    mask = jnp.ones(BATCH, jnp.float32)
+    params, opt_state = fns.init(jax.random.key(0), sup, x)
+
+    for _ in range(WARMUP):
+        params, opt_state, loss = fns.train_step(params, opt_state, sup, x, y, mask)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, opt_state, loss = fns.train_step(params, opt_state, sup, x, y, mask)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / ITERS
+
+    n_nodes = dataset.n_nodes
+    value = BATCH * seq_len * n_nodes / dt
+
+    vs_baseline = None
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks", "baseline.json")
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        ref = base.get("torch_cpu_region_ts_per_sec")
+        if ref:
+            vs_baseline = value / ref
+
+    print(json.dumps({
+        "metric": "region-timesteps/sec/chip",
+        "value": round(value, 1),
+        "unit": "region-timesteps/s",
+        "vs_baseline": round(vs_baseline, 2) if vs_baseline is not None else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Reference-equivalent PyTorch throughput baseline at bench.py's shapes.
+
+The reference repo publishes no numbers and ships no dataset (SURVEY.md
+§6), so the comparison anchor must be established here: an independent
+PyTorch implementation of the same architecture (M parallel contextual-
+gated-LSTM branches over K-support graph convolutions, summed fusion,
+linear head) trained with Adam+L2 at identical shapes. Runs on whatever
+torch device is available (CPU in this image; pass a CUDA device on a GPU
+host to anchor the >=10x target of BASELINE.json).
+
+Writes ``benchmarks/baseline.json``; ``bench.py`` reads it for
+``vs_baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import torch
+from torch import nn
+
+ROWS = 16
+SERIAL, DAILY, WEEKLY = 10, 1, 1
+BATCH = 64
+WARMUP = 2
+ITERS = 10
+
+
+class KSupportConv(nn.Module):
+    """y = relu(cat_k(A_k x) W + b), one weight across the K propagations."""
+
+    def __init__(self, k: int, d_in: int, d_out: int):
+        super().__init__()
+        self.proj = nn.Linear(k * d_in, d_out)
+
+    def forward(self, supports, x):  # (K,N,N), (B,N,F)
+        mixed = torch.einsum("knm,bmf->bnkf", supports, x).flatten(2)
+        return torch.relu(self.proj(mixed))
+
+
+class GatedBranch(nn.Module):
+    """One graph view: temporal gate (paper eqs. 6-9) -> shared LSTM -> conv."""
+
+    def __init__(self, k: int, seq_len: int, d_in: int, d_rnn: int, layers: int, d_gcn: int):
+        super().__init__()
+        self.time_conv = KSupportConv(k, seq_len, seq_len)
+        self.gate_fc = nn.Linear(seq_len, seq_len)
+        self.rnn = nn.LSTM(d_in, d_rnn, num_layers=layers, batch_first=True)
+        self.out_conv = KSupportConv(k, d_rnn, d_gcn)
+
+    def forward(self, supports, seq):  # (B,T,N,C)
+        b, t, n, c = seq.shape
+        hist = seq.sum(-1).transpose(1, 2)  # (B,N,T)
+        ctx = hist + self.time_conv(supports, hist)
+        gate = torch.sigmoid(self.gate_fc(torch.relu(self.gate_fc(ctx.mean(1)))))
+        gated = seq * gate[:, :, None, None]
+        flat = gated.transpose(1, 2).reshape(b * n, t, c)
+        states, _ = self.rnn(flat)
+        region_state = states[:, -1].reshape(b, n, -1)
+        return self.out_conv(supports, region_state)
+
+
+class MultiGraphForecaster(nn.Module):
+    def __init__(self, m: int, k: int, seq_len: int, d_in: int,
+                 d_rnn: int = 64, layers: int = 3, d_gcn: int = 64):
+        super().__init__()
+        self.branches = nn.ModuleList(
+            GatedBranch(k, seq_len, d_in, d_rnn, layers, d_gcn) for _ in range(m)
+        )
+        self.head = nn.Linear(d_gcn, d_in)
+
+    def forward(self, supports_stack, seq):  # (M,K,N,N), (B,T,N,C)
+        total = sum(br(supports_stack[i], seq) for i, br in enumerate(self.branches))
+        return self.head(total)
+
+
+def main() -> None:
+    device = "cuda" if torch.cuda.is_available() else "cpu"
+    torch.manual_seed(0)
+    seq_len = SERIAL + DAILY + WEEKLY
+    n = ROWS * ROWS
+    rng = np.random.default_rng(0)
+    supports = torch.tensor(
+        (rng.standard_normal((3, 3, n, n)) * 0.1).astype(np.float32), device=device
+    )
+    x = torch.tensor(rng.standard_normal((BATCH, seq_len, n, 1)).astype(np.float32),
+                     device=device)
+    y = torch.tensor(rng.standard_normal((BATCH, n, 1)).astype(np.float32) * 0.1,
+                     device=device)
+
+    model = MultiGraphForecaster(m=3, k=3, seq_len=seq_len, d_in=1).to(device)
+    opt = torch.optim.Adam(model.parameters(), lr=2e-3, weight_decay=1e-4)
+    crit = nn.MSELoss()
+
+    def step():
+        opt.zero_grad()
+        loss = crit(model(supports, x), y)
+        loss.backward()
+        opt.step()
+        return loss
+
+    for _ in range(WARMUP):
+        step()
+    if device == "cuda":
+        torch.cuda.synchronize()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step()
+    if device == "cuda":
+        torch.cuda.synchronize()
+    dt = (time.perf_counter() - t0) / ITERS
+
+    value = BATCH * seq_len * n / dt
+    out = {
+        "torch_cpu_region_ts_per_sec": value,
+        "device": device,
+        "torch_version": torch.__version__,
+        "threads": torch.get_num_threads(),
+        "shapes": {"rows": ROWS, "seq_len": seq_len, "batch": BATCH,
+                   "m_graphs": 3, "n_supports": 3},
+        "step_seconds": dt,
+        "final_loss": float(loss.detach()),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

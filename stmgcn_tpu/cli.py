@@ -136,6 +136,9 @@ def main(argv=None) -> int:
         # configuration errors (mesh size, divisibility, splits) — no traceback
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except FileNotFoundError as e:
+        print(f"error: {e.filename or e} not found", file=sys.stderr)
+        return 1
     try:
         if args.resume:
             meta = trainer.restore()

@@ -96,8 +96,10 @@ def make_step_fns(
         loss_val, pred = loss_fn(params, supports, x, y, mask)
         return loss_val, pred
 
+    # init is jitted too: eager flax init dispatches hundreds of tiny ops,
+    # which is pathologically slow on remote-tunneled TPU backends.
     return StepFns(
-        init=init,
+        init=jax.jit(init),
         train_step=jax.jit(train_step, donate_argnums=(0, 1)),
         eval_step=jax.jit(eval_step),
     )

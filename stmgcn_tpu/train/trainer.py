@@ -131,6 +131,14 @@ class Trainer:
         meta.update(self.extra_meta)
         return meta
 
+    def _place_batch(self, batch):
+        x = self.placement.put(batch.x, "x")
+        y = self.placement.put(batch.y, "y")
+        mask = self.placement.put(
+            (np.arange(len(batch)) < batch.n_real).astype(np.float32), "mask"
+        )
+        return x, y, mask
+
     def _run_epoch(self, mode: str, train: bool) -> float:
         """Sample-weighted mean loss over a mode (``Model_Trainer.py:43-44``)."""
         total, count = 0.0, 0
@@ -142,11 +150,7 @@ class Trainer:
             epoch=self.epoch,
             pad_last=True,
         ):
-            x = self.placement.put(batch.x, "x")
-            y = self.placement.put(batch.y, "y")
-            mask = self.placement.put(
-                (np.arange(len(batch)) < batch.n_real).astype(np.float32), "mask"
-            )
+            x, y, mask = self._place_batch(batch)
             if train:
                 self.params, self.opt_state, loss = self.step_fns.train_step(
                     self.params, self.opt_state, self.supports, x, y, mask
@@ -233,11 +237,7 @@ class Trainer:
         for mode in modes:
             preds, trues = [], []
             for batch in self.dataset.batches(mode, self.batch_size, pad_last=True):
-                x = self.placement.put(batch.x, "x")
-                y = self.placement.put(batch.y, "y")
-                mask = self.placement.put(
-                    (np.arange(len(batch)) < batch.n_real).astype(np.float32), "mask"
-                )
+                x, y, mask = self._place_batch(batch)
                 _, pred = self.step_fns.eval_step(params, self.supports, x, y, mask)
                 preds.append(np.asarray(pred)[: batch.n_real])
                 trues.append(batch.y[: batch.n_real])

@@ -1,6 +1,6 @@
 """Numeric ops: graph-support builders, graph convolution, recurrence, kernels."""
 
-from stmgcn_tpu.ops.chebconv import ChebGraphConv
+from stmgcn_tpu.ops.chebconv import ChebGraphConv, SparseChebGraphConv
 from stmgcn_tpu.ops.graph import (
     SupportConfig,
     build_supports,
@@ -19,6 +19,7 @@ from stmgcn_tpu.ops.lstm import StackedLSTM
 
 __all__ = [
     "ChebGraphConv",
+    "SparseChebGraphConv",
     "StackedLSTM",
     "SupportConfig",
     "build_supports",

@@ -21,7 +21,33 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["ChebGraphConv"]
+__all__ = ["ChebGraphConv", "SparseChebGraphConv"]
+
+
+def _conv_params(mod, f_in: int):
+    """The shared ``(K*F_in, F_out)`` weight + bias (``GCN.py:17-22`` layout)."""
+    w = mod.param(
+        "W",
+        nn.initializers.xavier_normal(),
+        (mod.n_supports * f_in, mod.features),
+        mod.param_dtype,
+    )
+    b = (
+        mod.param("b", nn.initializers.zeros_init(), (mod.features,), mod.param_dtype)
+        if mod.use_bias
+        else None
+    )
+    return w, b
+
+
+def _project(stacked, w, b, activation):
+    """Shared projection/bias/activation tail of both conv variants."""
+    out = stacked @ w
+    if b is not None:
+        out = out + b
+    if activation is not None:
+        out = activation(out)
+    return out
 
 
 class ChebGraphConv(nn.Module):
@@ -45,25 +71,55 @@ class ChebGraphConv(nn.Module):
                 f"expected {self.n_supports} supports, got {supports.shape[0]}"
             )
         batch, n_nodes, f_in = x.shape
-        w = self.param(
-            "W",
-            nn.initializers.xavier_normal(),
-            (self.n_supports * f_in, self.features),
-            self.param_dtype,
-        )
-        b = (
-            self.param("b", nn.initializers.zeros_init(), (self.features,), self.param_dtype)
-            if self.use_bias
-            else None
-        )
+        w, b = _conv_params(self, f_in)
         supports, x, w, b = nn.dtypes.promote_dtype(supports, x, w, b, dtype=self.dtype)
 
         # All K propagations at once; k-major flatten == torch.cat order.
         propagated = jnp.einsum("kij,bjf->bikf", supports, x)
         stacked = propagated.reshape(batch, n_nodes, self.n_supports * f_in)
-        out = stacked @ w
-        if b is not None:
-            out = out + b
-        if self.activation is not None:
-            out = self.activation(out)
-        return out
+        return _project(stacked, w, b, self.activation)
+
+
+class SparseChebGraphConv(nn.Module):
+    """Graph convolution over K block-sparse supports (Pallas SpMM path).
+
+    Same parameters and math as :class:`ChebGraphConv` (identical param
+    names/shapes, so trained weights are interchangeable), but the K
+    support propagations run through the block-CSR Pallas kernel in
+    :mod:`stmgcn_tpu.ops.spmm` instead of a dense einsum — the memory/FLOP
+    win for the large-N configs where dense ``(K, N, N)`` supports are
+    mostly zeros. Call with a K-tuple of :class:`~stmgcn_tpu.ops.spmm.
+    BlockSparse` supports built offline via ``spmm.from_dense``.
+    """
+
+    n_supports: int
+    features: int
+    use_bias: bool = True
+    activation: Optional[Callable] = nn.relu
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, supports, x: jnp.ndarray) -> jnp.ndarray:
+        from stmgcn_tpu.ops.spmm import spmm
+
+        if len(supports) != self.n_supports:
+            raise ValueError(
+                f"expected {self.n_supports} supports, got {len(supports)}"
+            )
+        batch, n_nodes, f_in = x.shape
+        w, b = _conv_params(self, f_in)
+        x, w, b = nn.dtypes.promote_dtype(x, w, b, dtype=self.dtype)
+        # (B, N, F) -> (N, B*F): one SpMM per support over all batch/features
+        x_mat = x.transpose(1, 0, 2).reshape(n_nodes, batch * f_in)
+        # kernel accumulates fp32; cast back to the compute dtype
+        propagated = jnp.stack(
+            [spmm(bs, x_mat).astype(x.dtype) for bs in supports], axis=0
+        )
+        # (K, N, B*F) -> (B, N, K*F), k-major to match the dense layout
+        stacked = (
+            propagated.reshape(self.n_supports, n_nodes, batch, f_in)
+            .transpose(2, 1, 0, 3)
+            .reshape(batch, n_nodes, self.n_supports * f_in)
+        )
+        return _project(stacked, w, b, self.activation)

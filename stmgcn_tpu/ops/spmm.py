@@ -1,0 +1,204 @@
+"""Block-sparse SpMM as a Pallas TPU kernel.
+
+The framework's hot contraction is ``out = A_k @ x`` over the support
+stack (``GCN.py:34-36`` in the reference, the fused einsum in
+:mod:`stmgcn_tpu.ops.chebconv` here). Supports are *dense* ``(N, N)``
+arrays in the reference — fine at N=58, quadratic waste at the scaled
+50x50-grid config (N=2500) where a Chebyshev support of a rook grid has
+<1% nonzero blocks (SURVEY.md §2 quirk 8, §7 hard part 1).
+
+This module stores a support as **block-CSR with a uniform block-column
+count**: the ``(N, N)`` matrix padded to 128-aligned tiles, only nonzero
+``(128, 128)`` blocks kept, every block-row padded to the same number of
+block-columns with zero blocks (index 0) so shapes are static. The kernel
+walks ``grid = (block_rows, M_tiles, block_cols)`` with the block-column
+index list scalar-prefetched (``PrefetchScalarGridSpec``) so the x-tile
+DMA for block ``(r, c)`` fetches row-block ``idx[r, c]`` directly from
+HBM — compute stays on the MXU via 128x128 ``jnp.dot`` tiles accumulated
+in the revisited output block.
+
+Gradient: supports are offline constants (never trained), so the custom
+VJP only produces ``dx = A^T @ g``, reusing the kernel with the
+pre-transposed block structure; ``None`` cotangents for the structure
+arrays.
+
+Off-TPU the kernel runs in Pallas interpret mode (tests), and
+:func:`spmm_dense_reference` provides the einsum equivalent for
+cross-checking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable off-TPU too; guard anyway for exotic builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["BlockSparse", "from_dense", "spmm", "spmm_dense_reference"]
+
+TILE = 128
+
+
+def _ceil_to(n: int, t: int) -> int:
+    return -(-n // t) * t
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BlockSparse:
+    """Uniform block-CSR support matrix plus its pre-transposed structure."""
+
+    data: jnp.ndarray  # (R, C, TILE, TILE) nonzero blocks (zero-padded rows)
+    idx: jnp.ndarray  # (R, C) int32 block-column indices
+    data_t: jnp.ndarray  # transpose structure, same layout
+    idx_t: jnp.ndarray
+    n: int  # original (unpadded) dimension
+    tile: int
+
+    def tree_flatten(self):
+        return (self.data, self.idx, self.data_t, self.idx_t), (self.n, self.tile)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, idx, data_t, idx_t = children
+        n, tile = aux
+        return cls(data=data, idx=idx, data_t=data_t, idx_t=idx_t, n=n, tile=tile)
+
+    @property
+    def block_rows(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def block_cols_per_row(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def density(self) -> float:
+        """Stored fraction of the dense padded matrix (1.0 = no savings)."""
+        return self.block_cols_per_row / self.block_rows
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.idx.nbytes + self.data_t.nbytes + self.idx_t.nbytes
+
+
+def _to_blocks(mat: np.ndarray, tile: int):
+    """Dense (N, N) -> uniform block-CSR (data, idx) numpy arrays."""
+    n_pad = _ceil_to(mat.shape[0], tile)
+    padded = np.zeros((n_pad, n_pad), dtype=np.float32)
+    padded[: mat.shape[0], : mat.shape[1]] = mat
+    r = n_pad // tile
+    blocks = padded.reshape(r, tile, r, tile).transpose(0, 2, 1, 3)
+    nonzero = np.any(blocks != 0.0, axis=(2, 3))  # (R, R)
+    c_max = max(int(nonzero.sum(axis=1).max()), 1)
+    data = np.zeros((r, c_max, tile, tile), dtype=np.float32)
+    idx = np.zeros((r, c_max), dtype=np.int32)
+    for i in range(r):
+        cols = np.flatnonzero(nonzero[i])
+        data[i, : len(cols)] = blocks[i, cols]
+        idx[i, : len(cols)] = cols
+        # padding entries keep idx 0 with zero data: harmless accumulation
+    return data, idx
+
+
+def from_dense(mat, tile: int = TILE) -> BlockSparse:
+    """Build a :class:`BlockSparse` (and its transpose structure) on the host."""
+    mat = np.asarray(mat, dtype=np.float32)
+    if mat.ndim != 2 or mat.shape[0] != mat.shape[1]:
+        raise ValueError(f"support must be square (N, N), got {mat.shape}")
+    data, idx = _to_blocks(mat, tile)
+    data_t, idx_t = _to_blocks(mat.T, tile)
+    return BlockSparse(
+        data=jnp.asarray(data),
+        idx=jnp.asarray(idx),
+        data_t=jnp.asarray(data_t),
+        idx_t=jnp.asarray(idx_t),
+        n=mat.shape[0],
+        tile=tile,
+    )
+
+
+def _spmm_kernel(idx_ref, data_ref, x_ref, out_ref):
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jnp.dot(
+        data_ref[0, 0], x_ref[:], preferred_element_type=jnp.float32
+    )
+
+
+def _spmm_call(data, idx, x, n, tile, interpret):
+    """Padded kernel invocation: data/idx block-CSR, x (N, M) -> (N, M)."""
+    r, c_max = idx.shape
+    n_pad = r * tile
+    m = x.shape[1]
+    tm = min(256, _ceil_to(m, TILE))
+    m_pad = _ceil_to(m, tm)
+    x_pad = jnp.zeros((n_pad, m_pad), x.dtype).at[: x.shape[0], :m].set(x)
+    mb = m_pad // tm
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r, mb, c_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, tile, tile), lambda i, j, c, idx_ref: (i, c, 0, 0)),
+            pl.BlockSpec((tile, tm), lambda i, j, c, idx_ref: (idx_ref[i, c], j)),
+        ],
+        out_specs=pl.BlockSpec((tile, tm), lambda i, j, c, idx_ref: (i, j)),
+    )
+    out = pl.pallas_call(
+        _spmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_pad, m_pad), jnp.float32),
+        interpret=interpret,
+    )(idx, data, x_pad)
+    return out[:n, :m]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _spmm_vjp(data, idx, data_t, idx_t, x, n, tile, interpret):
+    return _spmm_call(data, idx, x, n, tile, interpret)
+
+
+def _spmm_fwd(data, idx, data_t, idx_t, x, n, tile, interpret):
+    return _spmm_call(data, idx, x, n, tile, interpret), (data_t, idx_t)
+
+
+def _spmm_bwd(n, tile, interpret, res, g):
+    data_t, idx_t = res
+    dx = _spmm_call(data_t, idx_t, g, n, tile, interpret)
+    return (None, None, None, None, dx)
+
+
+_spmm_vjp.defvjp(_spmm_fwd, _spmm_bwd)
+
+
+def spmm(bs: BlockSparse, x: jnp.ndarray, interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``A @ x`` for a block-sparse support; ``x`` is ``(N, M)``.
+
+    ``interpret`` defaults to True off-TPU (CPU tests) and False on TPU.
+    """
+    if x.ndim != 2:
+        raise ValueError(f"x must be (N, M), got {x.shape}")
+    if x.shape[0] != bs.n:
+        raise ValueError(f"x has {x.shape[0]} rows, support expects {bs.n}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _spmm_vjp(bs.data, bs.idx, bs.data_t, bs.idx_t, x, bs.n, bs.tile, interpret)
+
+
+def spmm_dense_reference(mat, x) -> jnp.ndarray:
+    """Dense einsum equivalent, for cross-checking the kernel."""
+    return jnp.asarray(mat) @ jnp.asarray(x)

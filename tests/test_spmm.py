@@ -1,0 +1,123 @@
+"""Block-sparse Pallas SpMM tests (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.data import grid_adjacency
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.ops.chebconv import ChebGraphConv, SparseChebGraphConv
+from stmgcn_tpu.ops.spmm import BlockSparse, from_dense, spmm, spmm_dense_reference
+
+
+def banded_matrix(n, w, seed=0):
+    rng = np.random.default_rng(seed)
+    mat = rng.standard_normal((n, n)).astype(np.float32)
+    mat[np.abs(np.subtract.outer(np.arange(n), np.arange(n))) > w] = 0.0
+    return mat
+
+
+class TestFromDense:
+    def test_structure(self):
+        mat = banded_matrix(256, 10)
+        bs = from_dense(mat, tile=128)
+        assert bs.block_rows == 2
+        assert bs.idx.shape == bs.data.shape[:2]
+        assert bs.n == 256
+
+    def test_density_savings_on_grid_supports(self):
+        # Sparsity pays when the graph band is small relative to N: a 40x40
+        # grid (N=1600, 13 block-rows) with a K=2 Chebyshev band keeps ~3
+        # nonzero block-columns per row.
+        adj = grid_adjacency(40)
+        sup = SupportConfig("chebyshev", 2).build(adj)
+        bs = from_dense(sup[2], tile=128)  # T_2: the widest band
+        dense_bytes = sup[2].nbytes * 2  # forward + transpose copies
+        assert bs.density < 0.5
+        assert bs.nbytes < dense_bytes
+
+    def test_non_square_raises(self):
+        with pytest.raises(ValueError, match="square"):
+            from_dense(np.ones((4, 5)))
+
+    def test_pytree_roundtrip(self):
+        bs = from_dense(banded_matrix(128, 5))
+        leaves, treedef = jax.tree.flatten(bs)
+        bs2 = jax.tree.unflatten(treedef, leaves)
+        assert bs2.n == bs.n and bs2.tile == bs.tile
+
+
+class TestSpmm:
+    @pytest.mark.parametrize("n,m,w", [(256, 64, 10), (300, 100, 140), (128, 256, 5)])
+    def test_matches_dense(self, n, m, w):
+        mat = banded_matrix(n, w)
+        x = np.random.default_rng(1).standard_normal((n, m)).astype(np.float32)
+        got = spmm(from_dense(mat), jnp.asarray(x))
+        want = spmm_dense_reference(mat, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+    def test_chebyshev_grid_supports_match_dense(self):
+        adj = grid_adjacency(18)  # N=324 -> padded 384, 3 block rows
+        sups = SupportConfig("chebyshev", 2).build(adj)
+        x = np.random.default_rng(2).standard_normal((324, 48)).astype(np.float32)
+        for k in range(sups.shape[0]):
+            got = spmm(from_dense(sups[k]), jnp.asarray(x))
+            want = spmm_dense_reference(sups[k], x)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_gradient_matches_dense(self):
+        mat = banded_matrix(256, 20)
+        bs = from_dense(mat)
+        x = jnp.asarray(np.random.default_rng(3).standard_normal((256, 32)).astype(np.float32))
+        c = jnp.asarray(np.random.default_rng(4).standard_normal((256, 32)).astype(np.float32))
+
+        g_sparse = jax.grad(lambda x: jnp.sum(spmm(bs, x) * c))(x)
+        g_dense = jax.grad(lambda x: jnp.sum((jnp.asarray(mat) @ x) * c))(x)
+        np.testing.assert_allclose(np.asarray(g_sparse), np.asarray(g_dense),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_under_jit_and_value_and_grad(self):
+        mat = banded_matrix(128, 6)
+        bs = from_dense(mat)
+        x = jnp.ones((128, 16), jnp.float32)
+
+        @jax.jit
+        def loss(x):
+            return jnp.mean(spmm(bs, x) ** 2)
+
+        val, grad = jax.value_and_grad(loss)(x)
+        assert np.isfinite(float(val))
+        assert np.isfinite(np.asarray(grad)).all()
+
+    def test_shape_validation(self):
+        bs = from_dense(banded_matrix(128, 4))
+        with pytest.raises(ValueError, match="rows"):
+            spmm(bs, jnp.ones((64, 8)))
+        with pytest.raises(ValueError, match="\\(N, M\\)"):
+            spmm(bs, jnp.ones((128,)))
+
+
+class TestSparseChebGraphConv:
+    def test_matches_dense_layer_with_same_params(self):
+        adj = grid_adjacency(12)  # N=144
+        sups = SupportConfig("chebyshev", 2).build(adj)
+        x = jnp.asarray(
+            np.random.default_rng(5).standard_normal((4, 144, 6)).astype(np.float32)
+        )
+        dense_layer = ChebGraphConv(n_supports=3, features=8)
+        params = dense_layer.init(jax.random.key(0), jnp.asarray(sups), x)
+        want = dense_layer.apply(params, jnp.asarray(sups), x)
+
+        sparse_layer = SparseChebGraphConv(n_supports=3, features=8)
+        bs_list = tuple(from_dense(sups[k]) for k in range(3))
+        got = sparse_layer.apply(params, bs_list, x)  # identical param tree
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_support_count_mismatch(self):
+        bs = (from_dense(banded_matrix(128, 4)),)
+        layer = SparseChebGraphConv(n_supports=2, features=4)
+        with pytest.raises(ValueError, match="supports"):
+            layer.init(jax.random.key(0), bs, jnp.ones((2, 128, 3)))

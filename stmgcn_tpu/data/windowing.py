@@ -109,6 +109,13 @@ def sliding_windows(data, spec: WindowSpec) -> tuple[np.ndarray, np.ndarray]:
         raise ValueError(
             f"need more than burn_in={spec.burn_in} timesteps, got T={T}"
         )
+    if data.ndim == 3 and data.dtype == np.float32:
+        # native single-pass gather (stmgcn_tpu/native), numpy fallback below
+        from stmgcn_tpu import native
+
+        got = native.window_gather(data, spec.offsets, spec.burn_in)
+        if got is not None:
+            return got
     targets = np.arange(spec.burn_in, T)
     x = data[targets[:, None] + spec.offsets[None, :]]
     y = data[targets]

@@ -1,0 +1,106 @@
+"""ctypes binding for the native host kernels, with transparent fallback.
+
+The shared library is built on demand (``g++`` via the Makefile) and
+cached next to the sources; if the toolchain or binary is unavailable —
+or ``STMGCN_NATIVE=0`` is set — callers get ``None``/False and use their
+numpy fallbacks. The native path is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["available", "window_gather", "nonzero_block_scan"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libstmgcn_native.so")
+_SRC = os.path.join(_DIR, "stmgcn_native.cpp")
+
+_lib = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("STMGCN_NATIVE", "1") == "0":
+        return None
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            subprocess.run(
+                ["make", "-s", "-C", _DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.window_gather.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.window_gather.restype = None
+        lib.nonzero_block_scan.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_ubyte),
+        ]
+        lib.nonzero_block_scan.restype = None
+        _lib = lib
+    except (OSError, subprocess.SubprocessError):
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def window_gather(data: np.ndarray, offsets: np.ndarray, burn_in: int):
+    """Native ``(x, y)`` window extraction; ``None`` when the library is absent.
+
+    Semantics identical to the numpy gather in
+    :func:`stmgcn_tpu.data.windowing.sliding_windows`.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    T, N, C = data.shape
+    S = T - burn_in
+    x = np.empty((S, len(offsets), N, C), dtype=np.float32)
+    y = np.empty((S, N, C), dtype=np.float32)
+    lib.window_gather(
+        _fptr(data), T, N, C,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(offsets),
+        burn_in, _fptr(x), _fptr(y),
+    )
+    return x, y
+
+
+def nonzero_block_scan(padded: np.ndarray, tile: int):
+    """Native ``(R, R)`` bool nonzero-block map; ``None`` when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    padded = np.ascontiguousarray(padded, dtype=np.float32)
+    n_pad = padded.shape[0]
+    r = n_pad // tile
+    nz = np.zeros((r, r), dtype=np.uint8)
+    lib.nonzero_block_scan(
+        _fptr(padded), n_pad, tile,
+        nz.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+    )
+    return nz.astype(bool)

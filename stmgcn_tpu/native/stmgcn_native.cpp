@@ -1,0 +1,68 @@
+// Native host-side kernels for stmgcn-tpu.
+//
+// The reference's host pipeline is pure Python/numpy (SURVEY.md §2: zero
+// native components in the repo; its speed came from PyTorch's bundled
+// kernels). These are the TPU build's host-runtime equivalents for the two
+// paths that sit before device execution:
+//
+//   window_gather      — the sliding-window featurizer's gather
+//                        (Data_Container.py:125-146 semantics, vectorized):
+//                        one pass, writing straight into the output buffer
+//                        instead of materializing numpy fancy-index temps.
+//   nonzero_block_scan — the block-sparsity structure scan behind
+//                        ops/spmm.from_dense: marks which (tile x tile)
+//                        blocks of a padded (n_pad, n_pad) matrix are
+//                        nonzero, without numpy's (R, R, T, T) reduction
+//                        temporaries.
+//
+// Built as a plain C ABI shared library (ctypes binding in __init__.py);
+// every function has a numpy fallback, so the library is an accelerator,
+// never a requirement.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// data: (T, N, C) float32 row-major. offsets: n_off gather offsets relative
+// to each target t in [burn_in, T). Writes x: (S, n_off, N, C) and
+// y: (S, N, C) where S = T - burn_in.
+void window_gather(const float* data, int64_t T, int64_t N, int64_t C,
+                   const int64_t* offsets, int64_t n_off, int64_t burn_in,
+                   float* x_out, float* y_out) {
+  const int64_t frame = N * C;
+  const int64_t S = T - burn_in;
+  const size_t frame_bytes = static_cast<size_t>(frame) * sizeof(float);
+  for (int64_t s = 0; s < S; ++s) {
+    const int64_t t = burn_in + s;
+    float* xrow = x_out + static_cast<size_t>(s) * n_off * frame;
+    for (int64_t o = 0; o < n_off; ++o) {
+      std::memcpy(xrow + static_cast<size_t>(o) * frame,
+                  data + static_cast<size_t>(t + offsets[o]) * frame,
+                  frame_bytes);
+    }
+    std::memcpy(y_out + static_cast<size_t>(s) * frame,
+                data + static_cast<size_t>(t) * frame, frame_bytes);
+  }
+}
+
+// mat: (n_pad, n_pad) float32, n_pad % tile == 0. nz: (R, R) uint8 output
+// (R = n_pad / tile), set to 1 where the block holds any nonzero.
+void nonzero_block_scan(const float* mat, int64_t n_pad, int64_t tile,
+                        unsigned char* nz) {
+  const int64_t R = n_pad / tile;
+  for (int64_t i = 0; i < n_pad; ++i) {
+    const float* row = mat + static_cast<size_t>(i) * n_pad;
+    unsigned char* nzrow = nz + (i / tile) * R;
+    for (int64_t j = 0; j < n_pad; ++j) {
+      if (row[j] != 0.0f) {
+        nzrow[j / tile] = 1;
+        // skip to the next block boundary: everything until there maps to
+        // the same nz entry
+        j = ((j / tile) + 1) * tile - 1;
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -98,7 +98,11 @@ def _to_blocks(mat: np.ndarray, tile: int):
     padded[: mat.shape[0], : mat.shape[1]] = mat
     r = n_pad // tile
     blocks = padded.reshape(r, tile, r, tile).transpose(0, 2, 1, 3)
-    nonzero = np.any(blocks != 0.0, axis=(2, 3))  # (R, R)
+    from stmgcn_tpu import native
+
+    nonzero = native.nonzero_block_scan(padded, tile)  # (R, R); None w/o lib
+    if nonzero is None:
+        nonzero = np.any(blocks != 0.0, axis=(2, 3))
     c_max = max(int(nonzero.sum(axis=1).max()), 1)
     data = np.zeros((r, c_max, tile, tile), dtype=np.float32)
     idx = np.zeros((r, c_max), dtype=np.int32)

@@ -1,0 +1,86 @@
+"""Native C++ host-kernel tests: build, parity with numpy, fallback."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu import native
+from stmgcn_tpu.data import WindowSpec, sliding_windows
+
+needs_toolchain = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="no C++ toolchain",
+)
+
+
+@needs_toolchain
+class TestNative:
+    def test_builds_and_loads(self):
+        assert native.available()
+
+    def test_window_gather_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((24 * 8, 7, 2)).astype(np.float32)
+        spec = WindowSpec(3, 1, 0, 24)
+        x_native, y_native = native.window_gather(data, spec.offsets, spec.burn_in)
+        targets = np.arange(spec.burn_in, data.shape[0])
+        x_np = data[targets[:, None] + spec.offsets[None, :]]
+        y_np = data[targets]
+        np.testing.assert_array_equal(x_native, x_np)
+        np.testing.assert_array_equal(y_native, y_np)
+
+    def test_sliding_windows_uses_native_transparently(self):
+        # same public call, float32 3-D input -> native path; result must be
+        # bit-identical to the numpy gather (covered above); sanity here
+        data = np.random.default_rng(1).standard_normal((24 * 8, 5, 1)).astype(np.float32)
+        spec = WindowSpec(2, 1, 0, 24)
+        x, y = sliding_windows(data, spec)
+        assert x.shape == (data.shape[0] - spec.burn_in, spec.seq_len, 5, 1)
+        np.testing.assert_array_equal(x[:, -1], data[spec.burn_in - 1 : -1])
+
+    def test_nonzero_block_scan_matches_numpy(self):
+        rng = np.random.default_rng(2)
+        n_pad, tile = 512, 128
+        mat = np.zeros((n_pad, n_pad), dtype=np.float32)
+        # scatter some nonzeros, including one at a block edge
+        for i, j in [(0, 0), (127, 127), (128, 0), (300, 470), (511, 384)]:
+            mat[i, j] = rng.standard_normal()
+        got = native.nonzero_block_scan(mat, tile)
+        r = n_pad // tile
+        want = np.any(
+            mat.reshape(r, tile, r, tile).transpose(0, 2, 1, 3) != 0, axis=(2, 3)
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_spmm_from_dense_unchanged_by_native_path(self):
+        from stmgcn_tpu.ops.spmm import from_dense
+
+        rng = np.random.default_rng(3)
+        mat = rng.standard_normal((256, 256)).astype(np.float32)
+        mat[np.abs(np.subtract.outer(np.arange(256), np.arange(256))) > 9] = 0
+        bs = from_dense(mat)
+        # reconstruct the dense matrix from the block structure
+        r, c_max = bs.idx.shape
+        tile = bs.tile
+        recon = np.zeros((r * tile, r * tile), dtype=np.float32)
+        data = np.asarray(bs.data)
+        idx = np.asarray(bs.idx)
+        for i in range(r):
+            for c in range(c_max):
+                recon[i * tile : (i + 1) * tile,
+                      idx[i, c] * tile : (idx[i, c] + 1) * tile] += data[i, c]
+        np.testing.assert_array_equal(recon[:256, :256], mat)
+
+
+class TestFallback:
+    def test_disabled_via_env(self, monkeypatch):
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_tried", True)
+        assert not native.available()
+        assert native.window_gather(np.zeros((10, 2, 1), np.float32),
+                                    np.array([-1]), 1) is None
+        # public API still works through the numpy fallback
+        data = np.random.default_rng(4).standard_normal((30, 3, 1)).astype(np.float32)
+        x, y = sliding_windows(data, WindowSpec(2, 0, 0, 24))
+        assert x.shape == (28, 2, 3, 1)

@@ -55,6 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--out-dir", type=str, default=None)
+    p.add_argument("--horizon", type=int, default=None,
+                   help="forecast steps per sample (default 1, next-step)")
     p.add_argument("--rows", type=int, default=None,
                    help="synthetic city grid rows (N = rows^2)")
     p.add_argument("--timesteps", type=int, default=None,
@@ -81,6 +83,8 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.data.dates = tuple(args.dates)
     if args.obs_len is not None:
         cfg.data.serial_len, cfg.data.daily_len, cfg.data.weekly_len = args.obs_len
+    if args.horizon is not None:
+        cfg.data.horizon = args.horizon
     if args.rows is not None:
         cfg.data.rows = args.rows
     if args.timesteps is not None:

@@ -45,6 +45,7 @@ class DataConfig:
     serial_len: int = 3
     daily_len: int = 1
     weekly_len: int = 1
+    horizon: int = 1  # forecast steps per sample (1 = reference parity)
     dates: Optional[tuple] = None  # (train_s, train_e, test_s, test_e) MMDD
     val_ratio: float = 0.2
     year: int = 2017
@@ -177,10 +178,11 @@ def _multicity() -> ExperimentConfig:
 
 
 def _longhorizon() -> ExperimentConfig:
-    """BASELINE config 5: 24-step history, rematerialized scan."""
+    """BASELINE config 5: 24-step history + 24-step seq2seq forecast,
+    rematerialized scan."""
     return ExperimentConfig(
         name="longhorizon",
-        data=DataConfig(rows=10, serial_len=24, n_timesteps=24 * 7 * 6),
+        data=DataConfig(rows=10, serial_len=24, horizon=24, n_timesteps=24 * 7 * 6),
         model=ModelConfig(remat=True),
     )
 

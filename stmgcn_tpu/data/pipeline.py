@@ -35,7 +35,9 @@ __all__ = ["Batch", "DemandDataset"]
 
 @dataclasses.dataclass(frozen=True)
 class Batch:
-    """One step's input: ``x`` ``(B, seq_len, N, C)``, target ``y`` ``(B, N, C)``."""
+    """One step's input: ``x`` ``(B, seq_len, N, C)``; target ``y`` is
+    ``(B, N, C)`` for next-step forecasting or ``(B, H, N, C)`` for a
+    multi-step horizon."""
 
     x: np.ndarray
     y: np.ndarray
@@ -110,11 +112,11 @@ class DemandDataset:
 
     @property
     def n_nodes(self) -> int:
-        return self._ys[0].shape[1]
+        return self._xs[0].shape[2]  # y may carry a horizon axis; x never does
 
     @property
     def n_feats(self) -> int:
-        return self._ys[0].shape[2]
+        return self._xs[0].shape[3]
 
     def mode_size(self, mode: str) -> int:
         """Total samples for a mode across all cities."""

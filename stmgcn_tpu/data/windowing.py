@@ -43,6 +43,9 @@ class WindowSpec:
     daily_len: int = 1
     weekly_len: int = 1
     day_timesteps: int = 24
+    #: forecast steps per sample; 1 reproduces the reference's next-step
+    #: target (``Data_Container.py:132``), H>1 makes targets ``t .. t+H-1``
+    horizon: int = 1
 
     def __post_init__(self):
         if min(self.serial_len, self.daily_len, self.weekly_len) < 0:
@@ -51,6 +54,8 @@ class WindowSpec:
             raise ValueError("at least one window component must be non-empty")
         if self.day_timesteps <= 0:
             raise ValueError("day_timesteps must be positive")
+        if self.horizon < 1:
+            raise ValueError("horizon must be >= 1")
 
     @property
     def seq_len(self) -> int:
@@ -77,6 +82,10 @@ class WindowSpec:
             self.weekly_len**2 * self.day_timesteps * 7,
         )
 
+    def n_samples(self, n_timesteps: int) -> int:
+        """Windowed sample count for a ``T``-timestep series."""
+        return n_timesteps - self.burn_in - (self.horizon - 1)
+
     @property
     def offsets(self) -> np.ndarray:
         """Gather offsets (relative to the target index) in ``[weekly|daily|serial]`` order."""
@@ -96,8 +105,10 @@ def sliding_windows(data, spec: WindowSpec) -> tuple[np.ndarray, np.ndarray]:
     """Extract all ``(x_seq, y)`` samples from a ``(T, N, C)`` demand tensor.
 
     Returns ``x`` of shape ``(S, seq_len, N, C)`` and ``y`` of shape
-    ``(S, N, C)`` where ``S = T - spec.burn_in``; sample ``i`` targets
-    timestep ``spec.burn_in + i``. Equivalent to the reference's
+    ``(S, N, C)`` for ``horizon == 1`` (reference parity) or
+    ``(S, horizon, N, C)`` for multi-step forecasting, where
+    ``S = T - spec.burn_in - (spec.horizon - 1)``; sample ``i``'s first
+    target is timestep ``spec.burn_in + i``. Equivalent to the reference's
     ``get_feats`` + per-mode concatenation (``Data_Container.py:125-146`` and
     ``:82-86``) in a single gather.
     """
@@ -105,18 +116,23 @@ def sliding_windows(data, spec: WindowSpec) -> tuple[np.ndarray, np.ndarray]:
     if data.ndim < 1:
         raise ValueError("data must have a leading time axis")
     T = data.shape[0]
-    if T <= spec.burn_in:
+    h = spec.horizon
+    if T <= spec.burn_in + h - 1:
         raise ValueError(
-            f"need more than burn_in={spec.burn_in} timesteps, got T={T}"
+            f"need more than burn_in+horizon-1={spec.burn_in + h - 1} "
+            f"timesteps, got T={T}"
         )
-    if data.ndim == 3 and data.dtype == np.float32:
+    if h == 1 and data.ndim == 3 and data.dtype == np.float32:
         # native single-pass gather (stmgcn_tpu/native), numpy fallback below
         from stmgcn_tpu import native
 
         got = native.window_gather(data, spec.offsets, spec.burn_in)
         if got is not None:
             return got
-    targets = np.arange(spec.burn_in, T)
+    targets = np.arange(spec.burn_in, T - h + 1)
     x = data[targets[:, None] + spec.offsets[None, :]]
-    y = data[targets]
+    if h == 1:
+        y = data[targets]
+    else:
+        y = data[targets[:, None] + np.arange(h)[None, :]]
     return x, y

@@ -28,7 +28,9 @@ __all__ = ["build_dataset", "build_supports", "build_model", "build_trainer", "r
 def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
     """Load or synthesize demand data and window/split it per config."""
     d = cfg.data
-    window = WindowSpec(d.serial_len, d.daily_len, d.weekly_len, d.day_timesteps)
+    window = WindowSpec(
+        d.serial_len, d.daily_len, d.weekly_len, d.day_timesteps, horizon=d.horizon
+    )
     if d.path is not None:
         paths = [p for p in d.path.split(",") if p]
         if d.n_cities > 1 and len(paths) != d.n_cities:
@@ -54,7 +56,7 @@ def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
         # mesh axis is what the multicity config exercises.
         for c in cities[1:]:
             c.adjs = cities[0].adjs
-    n_samples = cities[0].demand.shape[0] - window.burn_in
+    n_samples = window.n_samples(cities[0].demand.shape[0])
     if d.dates is not None:
         split = date_splits(
             list(d.dates),
@@ -81,6 +83,7 @@ def build_model(cfg: ExperimentConfig, dataset: DemandDataset) -> STMGCN:
         n_supports=m.n_supports,
         seq_len=cfg.data.seq_len,
         input_dim=dataset.n_feats,
+        horizon=cfg.data.horizon,
         lstm_hidden_dim=m.lstm_hidden_dim,
         lstm_num_layers=m.lstm_num_layers,
         gcn_hidden_dim=m.gcn_hidden_dim,

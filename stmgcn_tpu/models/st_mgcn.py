@@ -69,12 +69,18 @@ class Branch(nn.Module):
 
 
 class STMGCN(nn.Module):
-    """Multi-graph spatiotemporal model; ``(B, T, N, C) -> (B, N, C)``."""
+    """Multi-graph spatiotemporal model; ``(B, T, N, C) -> (B, N, C)``.
+
+    With ``horizon > 1`` the head forecasts H steps jointly and the output
+    is ``(B, H, N, C)`` — a seq2seq extension the single-step reference
+    (``STMGCN.py:118``) does not have.
+    """
 
     m_graphs: int
     n_supports: int
     seq_len: int
     input_dim: int
+    horizon: int = 1
     lstm_hidden_dim: int = 64
     lstm_num_layers: int = 3
     gcn_hidden_dim: int = 64
@@ -115,6 +121,15 @@ class STMGCN(nn.Module):
         )
         feats = branches(supports_stack, obs_seq)  # (M, B, N, gcn_hidden)
         fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
-        return nn.Dense(
-            self.input_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        out = nn.Dense(
+            self.horizon * self.input_dim,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="head",
         )(fused)
+        if self.horizon == 1:
+            return out  # (B, N, C) — reference-shaped next-step prediction
+        batch, n_nodes = out.shape[:2]
+        return out.reshape(batch, n_nodes, self.horizon, self.input_dim).transpose(
+            0, 2, 1, 3
+        )  # (B, H, N, C)

@@ -13,7 +13,9 @@ Array-kind conventions (shapes as in the model):
 - ``supports`` ``(M, K, N, N)`` — rows (output nodes) sharded:
   ``P(None, None, 'region', None)``
 - ``x`` ``(B, T, N, C)`` — ``P('dp', None, 'region', None)``
-- ``y`` ``(B, N, C)`` — ``P('dp', 'region', None)``
+- ``y`` ``(B, N, C)`` — ``P('dp', 'region', None)``; the seq2seq
+  ``(B, H, N, C)`` form shards the node axis: ``P('dp', None, 'region',
+  None)`` (the horizon axis is never sharded)
 - ``mask`` ``(B,)`` — ``P('dp')``
 - ``state`` (params / optimizer) — replicated ``P()``
 """
@@ -41,10 +43,16 @@ class MeshPlacement:
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
 
-    def sharding(self, kind: str) -> NamedSharding:
+    def _spec(self, kind: str, ndim: int) -> P:
         if kind not in self.SPECS:
             raise ValueError(f"unknown array kind {kind!r}; known: {sorted(self.SPECS)}")
-        return NamedSharding(self.mesh, self.SPECS[kind])
+        if kind == "y" and ndim == 4:
+            # seq2seq targets (B, H, N, C): region stays on the node axis
+            return P("dp", None, "region", None)
+        return self.SPECS[kind]
+
+    def sharding(self, kind: str, ndim: int = 3) -> NamedSharding:
+        return NamedSharding(self.mesh, self._spec(kind, ndim))
 
     def put(self, tree, kind: str):
         """Place every array leaf of ``tree`` according to ``kind``.
@@ -52,8 +60,14 @@ class MeshPlacement:
         Batch axes must divide the mesh extents they shard over (use
         ``pad_last`` batching for static, divisible batch shapes).
         """
-        sharding = self.sharding(kind)
-        return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a), sharding), tree)
+        if kind not in self.SPECS:
+            raise ValueError(f"unknown array kind {kind!r}; known: {sorted(self.SPECS)}")
+        return jax.tree.map(
+            lambda a: jax.device_put(
+                jnp.asarray(a), self.sharding(kind, jnp.ndim(a))
+            ),
+            tree,
+        )
 
     def check_divisibility(self, batch_size: int, n_nodes: int) -> None:
         dp = self.mesh.shape["dp"]

@@ -19,6 +19,7 @@ explicit state. Notes:
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Callable
 
 import jax
@@ -76,8 +77,9 @@ def make_step_fns(
     def loss_fn(params, supports, x, y, mask):
         pred = model.apply(params, supports, x)
         err = _elementwise_loss(loss, pred.astype(jnp.float32), y.astype(jnp.float32))
-        w = mask[:, None, None]
-        per_sample_elems = y.shape[1] * y.shape[2]
+        # y is (B, N, C) single-step or (B, H, N, C) seq2seq; weight per sample
+        w = mask.reshape(mask.shape + (1,) * (y.ndim - 1))
+        per_sample_elems = math.prod(y.shape[1:])
         return (err * w).sum() / (mask.sum() * per_sample_elems), pred
 
     def init(rng, supports, x):

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 # Benchmark operating point ("Didi-Chengdu, 12-step" scale, BASELINE.json):
 # 16x16 region grid, 12-step observation window, batch 64, full M=3 ST-MGCN.
@@ -62,18 +61,15 @@ def main() -> None:
     mask = jnp.ones(BATCH, jnp.float32)
     params, opt_state = fns.init(jax.random.key(0), sup, x)
 
-    for _ in range(WARMUP):
-        params, opt_state, loss = fns.train_step(params, opt_state, sup, x, y, mask)
-    jax.block_until_ready(loss)
+    from stmgcn_tpu.utils import StepTimer, region_timesteps_per_sec
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        params, opt_state, loss = fns.train_step(params, opt_state, sup, x, y, mask)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / ITERS
+    timer = StepTimer(warmup=WARMUP)
+    for _ in range(WARMUP + ITERS):
+        params, opt_state, loss = timer.measure(
+            fns.train_step, params, opt_state, sup, x, y, mask
+        )
 
-    n_nodes = dataset.n_nodes
-    value = BATCH * seq_len * n_nodes / dt
+    value = region_timesteps_per_sec(BATCH, seq_len, dataset.n_nodes, timer.mean)
 
     vs_baseline = None
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -66,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--virtual-devices", type=int, default=None, metavar="N",
                    help="emulate N devices on CPU (for mesh dry-runs; implies "
                         "--platform cpu)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans (fail fast at the op producing NaN)")
+    p.add_argument("--profile", type=str, default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into DIR")
     p.add_argument("--resume", action="store_true",
                    help="resume from <out-dir>/latest.ckpt before training")
     p.add_argument("--test-only", action="store_true",
@@ -131,6 +135,10 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.debug_nans:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
 
     from stmgcn_tpu.experiment import build_trainer  # defer heavy imports
 
@@ -147,9 +155,18 @@ def main(argv=None) -> int:
         if args.resume:
             meta = trainer.restore()
             print(f"Resumed from epoch {meta['epoch']} (best val {meta['best_val']:.5})")
-        if not args.test_only:
-            trainer.train()
-        results = trainer.test(modes=("train", "test"))
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            if args.profile:
+                from stmgcn_tpu.utils import trace
+
+                stack.enter_context(trace(args.profile))
+            if not args.test_only:
+                trainer.train()
+            results = trainer.test(modes=("train", "test"))
+        if args.profile:
+            print(f"profiler trace written to {args.profile}")
     except FileNotFoundError as e:
         print(f"error: {e.filename or e} not found"
               + (" — train first or check --out-dir" if args.test_only or args.resume else ""),

@@ -1,0 +1,5 @@
+"""Utilities: profiling/timing and numeric-debugging helpers."""
+
+from stmgcn_tpu.utils.profiling import StepTimer, region_timesteps_per_sec, trace
+
+__all__ = ["StepTimer", "region_timesteps_per_sec", "trace"]

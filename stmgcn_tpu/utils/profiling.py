@@ -1,0 +1,92 @@
+"""Tracing and throughput measurement.
+
+The reference's only observability is ``time.ctime()`` prints at phase
+boundaries (``Model_Trainer.py:21,62,74,96``; SURVEY.md §5.a). Here:
+
+- :class:`StepTimer` — steady-state step timing with device-completion
+  fences (``block_until_ready``), warmup exclusion, and percentile
+  summaries; wall-clock-only timing of async dispatch is the classic JAX
+  benchmarking mistake.
+- :func:`trace` — context manager around ``jax.profiler`` trace capture
+  for TensorBoard/XProf (per-op device timelines, fusion inspection).
+- :func:`region_timesteps_per_sec` — the framework's north-star
+  throughput metric (BASELINE.json): demand points advanced per second.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+import numpy as np
+
+__all__ = ["StepTimer", "region_timesteps_per_sec", "trace"]
+
+
+class StepTimer:
+    """Measure per-step wall time with proper device fencing.
+
+    Usage::
+
+        timer = StepTimer(warmup=3)
+        for batch in batches:
+            result = timer.measure(train_step, params, opt_state, *batch)
+        print(timer.summary())
+    """
+
+    def __init__(self, warmup: int = 3):
+        self.warmup = warmup
+        self._times: list = []
+        self._seen = 0
+
+    def measure(self, fn, *args, **kwargs):
+        """Run ``fn``, fence its result on device completion, record the time."""
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        self.record(time.perf_counter() - t0)
+        return out
+
+    def record(self, seconds: float) -> None:
+        """Record an externally-measured step (already fenced)."""
+        self._seen += 1
+        if self._seen > self.warmup:
+            self._times.append(seconds)
+
+    @property
+    def times(self) -> np.ndarray:
+        return np.asarray(self._times)
+
+    @property
+    def mean(self) -> float:
+        return float(self.times.mean()) if self._times else float("nan")
+
+    def summary(self) -> dict:
+        if not self._times:
+            return {"steps": 0}
+        t = self.times
+        return {
+            "steps": len(t),
+            "mean_s": float(t.mean()),
+            "p50_s": float(np.percentile(t, 50)),
+            "p95_s": float(np.percentile(t, 95)),
+            "min_s": float(t.min()),
+        }
+
+
+def region_timesteps_per_sec(
+    batch_size: int, seq_len: int, n_nodes: int, step_seconds: float
+) -> float:
+    """Demand points advanced per second — the BASELINE.json north-star."""
+    return batch_size * seq_len * n_nodes / step_seconds
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a ``jax.profiler`` trace viewable in TensorBoard/XProf."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

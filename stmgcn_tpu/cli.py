@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--cheb-k", type=int, default=None, help="max polynomial order K")
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default=None)
+    p.add_argument("--sparse", action="store_true", default=None,
+                   help="use the Pallas block-CSR SpMM path for graph convs")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--out-dir", type=str, default=None)
     p.add_argument("--horizon", type=int, default=None,
@@ -111,6 +113,8 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.model.K = args.cheb_k
     if args.dtype is not None:
         cfg.model.dtype = args.dtype
+    if args.sparse:
+        cfg.model.sparse = True
     return cfg
 
 

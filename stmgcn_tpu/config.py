@@ -75,6 +75,9 @@ class ModelConfig:
     gcn_hidden_dim: int = 64
     use_bias: bool = True
     shared_gate_fc: bool = True
+    #: route graph convolutions through the Pallas block-CSR SpMM (large
+    #: sparse graphs); branches loop instead of vmapping
+    sparse: bool = False
     remat: bool = False
     dtype: str = "float32"
 

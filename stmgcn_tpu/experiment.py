@@ -71,9 +71,22 @@ def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
     return DemandDataset(cities if len(cities) > 1 else cities[0], window, split)
 
 
-def build_supports(cfg: ExperimentConfig, dataset: DemandDataset) -> np.ndarray:
-    """Stacked ``(M, n_supports, N, N)`` supports from the dataset's graphs."""
-    return cfg.model.support_config.build_all(dataset.adjs.values())
+def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
+    """Supports from the dataset's graphs.
+
+    Dense mode: one stacked ``(M, n_supports, N, N)`` array. Sparse mode:
+    an M-tuple of K-tuples of :class:`~stmgcn_tpu.ops.spmm.BlockSparse`
+    for the Pallas SpMM path.
+    """
+    dense = cfg.model.support_config.build_all(dataset.adjs.values())
+    if not cfg.model.sparse:
+        return dense
+    from stmgcn_tpu.ops.spmm import from_dense
+
+    return tuple(
+        tuple(from_dense(dense[m, k]) for k in range(dense.shape[1]))
+        for m in range(dense.shape[0])
+    )
 
 
 def build_model(cfg: ExperimentConfig, dataset: DemandDataset) -> STMGCN:
@@ -89,6 +102,7 @@ def build_model(cfg: ExperimentConfig, dataset: DemandDataset) -> STMGCN:
         gcn_hidden_dim=m.gcn_hidden_dim,
         use_bias=m.use_bias,
         shared_gate_fc=m.shared_gate_fc,
+        sparse=m.sparse,
         remat=m.remat,
         dtype=m.compute_dtype if m.dtype != "float32" else None,
     )
@@ -105,6 +119,11 @@ def build_trainer(
     raises — silent fallback to one device would misreport the benchmark
     configs (3/4) as sharded.
     """
+    if placement is None and cfg.model.sparse and cfg.mesh.n_devices > 1:
+        raise ValueError(
+            "sparse mode does not support mesh sharding yet — use dense "
+            "supports for multi-device configs"
+        )
     if placement is None and cfg.mesh.n_devices > 1:
         # Fail fast (before data/support construction) if the mesh can't exist.
         from stmgcn_tpu.parallel import MeshPlacement, mesh_from_config

@@ -27,7 +27,7 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from stmgcn_tpu.ops.chebconv import ChebGraphConv
+from stmgcn_tpu.ops.chebconv import conv_cls
 from stmgcn_tpu.ops.lstm import StackedLSTM
 
 __all__ = ["CGLSTM", "ContextualGate"]
@@ -41,15 +41,16 @@ class ContextualGate(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
+    sparse: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, supports: jnp.ndarray, obs_seq: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, supports, obs_seq: jnp.ndarray) -> jnp.ndarray:
         """``obs_seq`` ``(B, T, N, C)`` -> gated ``(B, T, N, C)``."""
         x_seq = obs_seq.sum(axis=-1)  # collapse features (STMGCN.py:36)
         x_nt = x_seq.transpose(0, 2, 1)  # (B, N, T): history as node features
-        g = ChebGraphConv(
+        g = conv_cls(self.sparse)(
             n_supports=self.n_supports,
             features=self.seq_len,
             use_bias=self.use_bias,
@@ -86,12 +87,13 @@ class CGLSTM(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
+    sparse: bool = False
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, supports: jnp.ndarray, obs_seq: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, supports, obs_seq: jnp.ndarray) -> jnp.ndarray:
         batch, seq_len, n_nodes, n_feats = obs_seq.shape
         gated = ContextualGate(
             n_supports=self.n_supports,
@@ -99,6 +101,7 @@ class CGLSTM(nn.Module):
             use_bias=self.use_bias,
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
+            sparse=self.sparse,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="gate",

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from stmgcn_tpu.models.cg_lstm import CGLSTM
-from stmgcn_tpu.ops.chebconv import ChebGraphConv
+from stmgcn_tpu.ops.chebconv import conv_cls
 
 __all__ = ["STMGCN", "Branch"]
 
@@ -38,12 +38,13 @@ class Branch(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
+    sparse: bool = False
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, supports: jnp.ndarray, obs_seq: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, supports, obs_seq: jnp.ndarray) -> jnp.ndarray:
         rnn_out = CGLSTM(
             n_supports=self.n_supports,
             seq_len=self.seq_len,
@@ -52,12 +53,13 @@ class Branch(nn.Module):
             use_bias=self.use_bias,
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
+            sparse=self.sparse,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="cg_lstm",
         )(supports, obs_seq)
-        return ChebGraphConv(
+        return conv_cls(self.sparse)(
             n_supports=self.n_supports,
             features=self.gcn_hidden_dim,
             use_bias=self.use_bias,
@@ -87,25 +89,18 @@ class STMGCN(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
+    #: sparse mode: supports are an M-tuple of K-tuples of BlockSparse and
+    #: branches run as a Python loop (the Pallas SpMM is not vmappable over
+    #: the graph axis); params live under branch_0..branch_{M-1} instead of
+    #: a stacked axis
+    sparse: bool = False
+    vmap_branches: bool = True
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
-    @nn.compact
-    def __call__(self, supports_stack: jnp.ndarray, obs_seq: jnp.ndarray) -> jnp.ndarray:
-        """``supports_stack`` ``(M, K, N, N)``; ``obs_seq`` ``(B, T, N, C)``."""
-        if supports_stack.ndim != 4 or supports_stack.shape[0] != self.m_graphs:
-            raise ValueError(
-                f"supports_stack must be ({self.m_graphs}, K, N, N), "
-                f"got {supports_stack.shape}"
-            )  # STMGCN.py:107
-        branches = nn.vmap(
-            Branch,
-            in_axes=(0, None),
-            out_axes=0,
-            variable_axes={"params": 0},
-            split_rngs={"params": True},
-        )(
+    def _branch_kwargs(self) -> dict:
+        return dict(
             n_supports=self.n_supports,
             seq_len=self.seq_len,
             lstm_hidden_dim=self.lstm_hidden_dim,
@@ -114,13 +109,46 @@ class STMGCN(nn.Module):
             use_bias=self.use_bias,
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
+            sparse=self.sparse,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
-            name="branches",
         )
-        feats = branches(supports_stack, obs_seq)  # (M, B, N, gcn_hidden)
-        fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
+
+    @nn.compact
+    def __call__(self, supports_stack, obs_seq: jnp.ndarray) -> jnp.ndarray:
+        """``supports_stack``: dense ``(M, K, N, N)`` array, or (sparse mode)
+        an M-sequence of K-sequences of ``BlockSparse``; ``obs_seq``
+        ``(B, T, N, C)``."""
+        if self.sparse:
+            if len(supports_stack) != self.m_graphs:
+                raise ValueError(
+                    f"need {self.m_graphs} sparse support groups, "
+                    f"got {len(supports_stack)}"
+                )
+        elif supports_stack.ndim != 4 or supports_stack.shape[0] != self.m_graphs:
+            raise ValueError(
+                f"supports_stack must be ({self.m_graphs}, K, N, N), "
+                f"got {supports_stack.shape}"
+            )  # STMGCN.py:107
+        if self.sparse or not self.vmap_branches:
+            feats = [
+                Branch(**self._branch_kwargs(), name=f"branch_{m}")(
+                    supports_stack[m], obs_seq
+                )
+                for m in range(self.m_graphs)
+            ]
+            fused = sum(feats)  # aggregation (STMGCN.py:116)
+        else:
+            branches = nn.vmap(
+                Branch,
+                in_axes=(0, None),
+                out_axes=0,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+            )(**self._branch_kwargs(), name="branches")
+            feats = branches(supports_stack, obs_seq)  # (M, B, N, gcn_hidden)
+            fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
         out = nn.Dense(
             self.horizon * self.input_dim,
             dtype=self.dtype,

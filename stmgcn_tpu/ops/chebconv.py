@@ -21,7 +21,13 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["ChebGraphConv", "SparseChebGraphConv"]
+__all__ = ["ChebGraphConv", "SparseChebGraphConv", "conv_cls"]
+
+
+def conv_cls(sparse: bool):
+    """The graph-conv class for a support representation (one mapping, shared
+    by every call site that dispatches on sparse mode)."""
+    return SparseChebGraphConv if sparse else ChebGraphConv
 
 
 def _conv_params(mod, f_in: int):

@@ -80,7 +80,17 @@ class Trainer:
         # device placement hook; stmgcn_tpu.parallel.MeshPlacement shards over
         # a mesh, the default puts everything on the default device
         self.placement = placement or _DefaultPlacement()
-        self.supports = self.placement.put(np.asarray(supports), "supports")
+        # supports: dense (M, K, N, N) array or a BlockSparse pytree
+        if not isinstance(supports, (np.ndarray, jnp.ndarray)) and hasattr(
+            self.placement, "mesh"
+        ):
+            # guard at the seam the config-level check cannot see (explicit
+            # placement / direct Trainer construction)
+            raise ValueError(
+                "sparse (pytree) supports cannot be mesh-sharded yet — "
+                "pass dense supports or a single-device placement"
+            )
+        self.supports = self.placement.put(supports, "supports")
 
         for mode in ("train", "validate"):
             if dataset.mode_size(mode) == 0:

@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--virtual-devices", type=int, default=None, metavar="N",
                    help="emulate N devices on CPU (for mesh dry-runs; implies "
                         "--platform cpu)")
+    p.add_argument("--matmul-precision", choices=("default", "high", "highest"),
+                   default=None,
+                   help="jax default matmul precision (TPU fp32 matmuls use "
+                        "fast bf16 passes under 'default'; 'highest' for "
+                        "iso-accuracy comparisons)")
+    p.add_argument("--distributed", action="store_true",
+                   help="join a multi-host job (jax.distributed.initialize; "
+                        "TPU pods auto-discover the coordinator)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans (fail fast at the op producing NaN)")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
@@ -143,6 +151,14 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_debug_nans", True)
+    if args.matmul_precision:
+        import jax
+
+        jax.config.update("jax_default_matmul_precision", args.matmul_precision)
+    if args.distributed:
+        from stmgcn_tpu.parallel import init_distributed
+
+        init_distributed()
 
     from stmgcn_tpu.experiment import build_trainer  # defer heavy imports
 
@@ -176,7 +192,10 @@ def main(argv=None) -> int:
               + (" — train first or check --out-dir" if args.test_only or args.resume else ""),
               file=sys.stderr)
         return 1
-    print(json.dumps({"preset": cfg.name, "results": results}))
+    import jax
+
+    if jax.process_index() == 0:  # one JSON line per job, not per host
+        print(json.dumps({"preset": cfg.name, "results": results}))
     return 0
 
 

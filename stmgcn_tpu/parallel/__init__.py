@@ -21,7 +21,13 @@ process groups; collectives within a slice ride ICI and across slices DCN.
 """
 
 from stmgcn_tpu.parallel.halo import halo_exchange
-from stmgcn_tpu.parallel.mesh import build_mesh, mesh_from_config
+from stmgcn_tpu.parallel.mesh import build_mesh, init_distributed, mesh_from_config
 from stmgcn_tpu.parallel.placement import MeshPlacement
 
-__all__ = ["MeshPlacement", "build_mesh", "halo_exchange", "mesh_from_config"]
+__all__ = [
+    "MeshPlacement",
+    "build_mesh",
+    "halo_exchange",
+    "init_distributed",
+    "mesh_from_config",
+]

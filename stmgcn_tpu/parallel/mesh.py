@@ -16,7 +16,25 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["build_mesh", "mesh_from_config"]
+__all__ = ["build_mesh", "init_distributed", "mesh_from_config"]
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host JAX job (the reference has no multi-node story).
+
+    Wraps ``jax.distributed.initialize``: on TPU pods all arguments are
+    discovered from the environment, so a bare ``init_distributed()`` per
+    host is enough; on other platforms pass the coordinator explicitly.
+    After this, ``jax.devices()`` spans every host and :func:`build_mesh`
+    lays the ``(dp, region)`` axes across the whole slice — XLA routes
+    collectives over ICI within a slice and DCN across slices. Call before
+    any other JAX operation.
+    """
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 
 def build_mesh(dp: int = 1, region: int = 1, devices: Optional[Sequence] = None) -> Mesh:

@@ -109,7 +109,11 @@ class Trainer:
         self.epoch = 0
         self.best_val = float("inf")
         self.patience_left = patience
-        os.makedirs(out_dir, exist_ok=True)
+        # In a multi-host job every process runs the same deterministic loop;
+        # only the lead process touches shared storage and stdout.
+        self.is_lead = jax.process_index() == 0
+        if self.is_lead:
+            os.makedirs(out_dir, exist_ok=True)
 
     # -- paths ----------------------------------------------------------
     @property
@@ -122,12 +126,18 @@ class Trainer:
 
     # -- internals ------------------------------------------------------
     def _log(self, msg: str) -> None:
-        if self.verbose:
+        if self.verbose and self.is_lead:
             print(msg, flush=True)
 
     def _record(self, record: dict) -> None:
+        if not self.is_lead:
+            return
         with open(os.path.join(self.out_dir, "history.jsonl"), "a") as f:
             f.write(json.dumps(record) + "\n")
+
+    def _save(self, path: str) -> None:
+        if self.is_lead:
+            save_checkpoint(path, self.params, self.opt_state, self._meta())
 
     def _meta(self) -> dict:
         meta = {
@@ -195,14 +205,14 @@ class Trainer:
                 )
                 self.best_val = val_loss
                 self.patience_left = self.patience
-                save_checkpoint(self.best_path, self.params, self.opt_state, self._meta())
+                self._save(self.best_path)
             else:
                 self.patience_left -= 1
                 self._log(
                     f"Epoch {epoch}, val_loss {val_loss:.5} does not improve "
                     f"from {self.best_val:.5} (patience {self.patience_left})"
                 )
-            save_checkpoint(self.latest_path, self.params, self.opt_state, self._meta())
+            self._save(self.latest_path)
             self._record(
                 {
                     "epoch": epoch,

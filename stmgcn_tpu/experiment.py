@@ -89,13 +89,14 @@ def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
     )
 
 
-def build_model(cfg: ExperimentConfig, dataset: DemandDataset) -> STMGCN:
+def build_model(cfg: ExperimentConfig, input_dim: int) -> STMGCN:
+    """Model from config + the one data-derived scalar (feature count)."""
     m = cfg.model
     return STMGCN(
         m_graphs=m.m_graphs,
         n_supports=m.n_supports,
         seq_len=cfg.data.seq_len,
-        input_dim=dataset.n_feats,
+        input_dim=input_dim,
         horizon=cfg.data.horizon,
         lstm_hidden_dim=m.lstm_hidden_dim,
         lstm_num_layers=m.lstm_num_layers,
@@ -131,7 +132,7 @@ def build_trainer(
         placement = MeshPlacement(mesh_from_config(cfg.mesh))
     dataset = build_dataset(cfg)
     supports = build_supports(cfg, dataset)
-    model = build_model(cfg, dataset)
+    model = build_model(cfg, dataset.n_feats)
     if placement is not None and hasattr(placement, "check_divisibility"):
         placement.check_divisibility(cfg.train.batch_size, dataset.n_nodes)
     t = cfg.train
@@ -149,7 +150,12 @@ def build_trainer(
         seed=t.seed,
         out_dir=t.out_dir,
         placement=placement,
-        extra_meta={"config": cfg.to_dict()},
+        extra_meta={
+            "config": cfg.to_dict(),
+            # data-derived model facts a checkpoint consumer needs to rebuild
+            # the model without the dataset
+            "derived": {"input_dim": dataset.n_feats, "n_nodes": dataset.n_nodes},
+        },
         verbose=verbose,
     )
 

@@ -1,0 +1,88 @@
+"""Checkpoint-to-forecast inference, no training stack required.
+
+The reference cannot do this: its checkpoints hold only a ``state_dict``
+and the normalization statistics live on the in-memory loader object
+(SURVEY.md §5.d), so a saved model cannot even denormalize its outputs.
+Here a checkpoint is self-sufficient — config, derived model facts, and
+normalizer statistics travel inside it — so serving is::
+
+    fc = Forecaster.from_checkpoint("output/best.ckpt")
+    demand_forecast = fc.predict(supports, history)   # raw demand units
+
+``supports`` are rebuilt from the city's adjacency matrices (offline,
+:class:`~stmgcn_tpu.ops.graph.SupportConfig`), which are data, not model
+state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stmgcn_tpu.config import ExperimentConfig
+from stmgcn_tpu.data.normalize import normalizer_from_dict
+from stmgcn_tpu.experiment import build_model
+from stmgcn_tpu.train.checkpoint import load_checkpoint
+
+__all__ = ["Forecaster"]
+
+
+class Forecaster:
+    """A trained ST-MGCN ready to forecast from raw demand history."""
+
+    def __init__(self, model, params, normalizer, config: ExperimentConfig, derived: dict):
+        self.model = model
+        self.params = params
+        self.normalizer = normalizer
+        self.config = config
+        self.derived = derived  # {"input_dim": C, "n_nodes": N}
+        self._apply = jax.jit(model.apply)
+
+    @classmethod
+    def from_checkpoint(cls, path: str) -> "Forecaster":
+        meta, params, _ = load_checkpoint(path, load_opt_state=False)
+        if "config" not in meta or "derived" not in meta:
+            raise ValueError(
+                f"{path} lacks the config/derived metadata needed to rebuild "
+                "the model (was it written by stmgcn_tpu.train.Trainer?)"
+            )
+        cfg = ExperimentConfig.from_dict(meta["config"])
+        normalizer = (
+            normalizer_from_dict(meta["normalizer"]) if "normalizer" in meta else None
+        )
+        model = build_model(cfg, meta["derived"]["input_dim"])
+        params = jax.tree.map(jnp.asarray, params)
+        return cls(model, params, normalizer, cfg, meta["derived"])
+
+    @property
+    def seq_len(self) -> int:
+        return self.config.data.seq_len
+
+    @property
+    def horizon(self) -> int:
+        return self.config.data.horizon
+
+    def predict(self, supports, history, *, normalized: bool = False) -> np.ndarray:
+        """Forecast demand from raw-scale history.
+
+        ``history``: ``(B, seq_len, N, C)`` windowed observations in raw
+        demand units (set ``normalized=True`` if already model-scaled);
+        ``supports``: the stacked ``(M, K, N, N)`` array (or sparse pytree)
+        built from the city's graphs. Returns raw-unit forecasts of shape
+        ``(B, N, C)`` or ``(B, H, N, C)``.
+        """
+        history = np.asarray(history, dtype=np.float32)
+        expected = (self.seq_len, self.derived["n_nodes"], self.derived["input_dim"])
+        if history.ndim != 4 or history.shape[1:] != expected:
+            raise ValueError(
+                f"history must be (B, seq_len={expected[0]}, n_nodes={expected[1]}, "
+                f"n_feats={expected[2]}) for this checkpoint, got {history.shape}"
+            )
+        if not normalized and self.normalizer is not None:
+            history = self.normalizer.transform(history)
+        pred = self._apply(self.params, supports, jnp.asarray(history))
+        pred = np.asarray(pred)
+        if self.normalizer is not None:
+            pred = self.normalizer.inverse(pred)
+        return pred

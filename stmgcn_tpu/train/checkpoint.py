@@ -47,26 +47,36 @@ def load_checkpoint(
     path: str,
     params_template: Optional[Any] = None,
     opt_state_template: Optional[Any] = None,
+    *,
+    load_opt_state: bool = True,
 ) -> tuple[dict, Any, Any]:
     """Read ``(meta, params, opt_state)`` back.
 
     With templates (the freshly-initialized structures), the exact pytree
     types are restored; without, params/opt_state come back as plain nested
     dicts — sufficient for ``model.apply`` at inference.
+    ``load_opt_state=False`` skips deserializing the optimizer blob
+    (~2x the parameter bytes) and returns ``None`` for it — the inference
+    cold-start path.
     """
     with open(path, "rb") as f:
         if f.read(len(_MAGIC)) != _MAGIC:
             raise ValueError(f"{path} is not a stmgcn-tpu checkpoint")
         blobs = []
-        for _ in range(3):
+        for i in range(3):
             (length,) = struct.unpack("<Q", f.read(8))
+            if i == 2 and not load_opt_state:
+                blobs.append(None)
+                break
             blobs.append(f.read(length))
     meta = json.loads(blobs[0].decode("utf-8"))
     if params_template is not None:
         params = serialization.from_bytes(params_template, blobs[1])
     else:
         params = serialization.msgpack_restore(blobs[1])
-    if opt_state_template is not None:
+    if blobs[2] is None:
+        opt_state = None
+    elif opt_state_template is not None:
         opt_state = serialization.from_bytes(opt_state_template, blobs[2])
     else:
         opt_state = serialization.msgpack_restore(blobs[2])

@@ -1,0 +1,71 @@
+"""Inference API tests: checkpoint -> Forecaster -> raw-unit predictions."""
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.experiment import build_dataset, build_supports, build_trainer
+from stmgcn_tpu.inference import Forecaster
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    out = tmp_path_factory.mktemp("ckpt")
+    cfg = preset("smoke")
+    cfg.data.n_timesteps = 24 * 7 * 2 + 48
+    cfg.train.epochs = 1
+    cfg.train.batch_size = 16
+    cfg.train.out_dir = str(out)
+    trainer = build_trainer(cfg, verbose=False)
+    trainer.train()
+    return cfg, trainer
+
+
+class TestForecaster:
+    def test_matches_trainer_eval(self, trained):
+        cfg, trainer = trained
+        fc = Forecaster.from_checkpoint(trainer.best_path)
+        assert fc.seq_len == cfg.data.seq_len and fc.horizon == 1
+
+        dataset = trainer.dataset
+        x, _ = dataset.arrays("test")
+        supports = build_supports(cfg, dataset)
+        # Forecaster path: raw-unit history in, raw-unit forecast out
+        raw_history = dataset.denormalize(x[:8])
+        got = fc.predict(supports, raw_history)
+
+        # trainer path: normalized eval + explicit denormalize
+        import jax.numpy as jnp
+
+        _, pred = trainer.step_fns.eval_step(
+            trainer.params, trainer.supports, jnp.asarray(x[:8]),
+            jnp.zeros((8,) + dataset.arrays("test")[1].shape[1:], jnp.float32),
+            jnp.ones(8),
+        )
+        want = dataset.denormalize(np.asarray(pred))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+    def test_normalized_input_path(self, trained):
+        cfg, trainer = trained
+        fc = Forecaster.from_checkpoint(trainer.best_path)
+        dataset = trainer.dataset
+        x, _ = dataset.arrays("validate")
+        supports = build_supports(cfg, dataset)
+        a = fc.predict(supports, dataset.denormalize(x[:4]))
+        b = fc.predict(supports, x[:4], normalized=True)
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-3)
+
+    def test_shape_validation(self, trained):
+        cfg, trainer = trained
+        fc = Forecaster.from_checkpoint(trainer.best_path)
+        with pytest.raises(ValueError, match="history"):
+            fc.predict(None, np.zeros((2, 99, 4, 1)))
+
+    def test_rejects_foreign_checkpoint(self, tmp_path, trained):
+        _, trainer = trained
+        from stmgcn_tpu.train import save_checkpoint
+
+        path = str(tmp_path / "bare.ckpt")
+        save_checkpoint(path, trainer.params, trainer.opt_state, {"epoch": 1})
+        with pytest.raises(ValueError, match="metadata"):
+            Forecaster.from_checkpoint(path)

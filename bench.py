@@ -28,7 +28,44 @@ WARMUP = int(os.environ.get("STMGCN_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 30))
 
 
+def _backend_watchdog(seconds: int = 180) -> None:
+    """Fail fast (to stderr, nonzero exit) if backend init hangs.
+
+    A wedged TPU tunnel can block the first device op indefinitely *inside
+    native code* (signal handlers never run), so the probe happens in a
+    child process the parent can time out and kill.
+    """
+    import subprocess
+    import sys
+
+    probe = (
+        "import jax, jax.numpy as jnp; "
+        "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()"
+    )
+    try:
+        subprocess.run(
+            [sys.executable, "-c", probe],
+            timeout=seconds,
+            check=True,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"bench: backend did not initialize within {seconds}s "
+            "(TPU tunnel unavailable?)",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    except subprocess.CalledProcessError as e:
+        print(
+            "bench: backend probe failed:\n" + e.stderr.decode()[-500:],
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+
 def main() -> None:
+    _backend_watchdog()
     import jax
     import numpy as np
 

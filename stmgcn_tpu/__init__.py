@@ -25,3 +25,23 @@ behavior (``file:line`` under ``/root/reference``) it is equivalent to.
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    """Lazy top-level API: ``stmgcn_tpu.preset(...)``, ``stmgcn_tpu.Forecaster``
+    etc., without importing jax at package-import time."""
+    lazy = {
+        "ExperimentConfig": "stmgcn_tpu.config",
+        "preset": "stmgcn_tpu.config",
+        "PRESETS": "stmgcn_tpu.config",
+        "build_trainer": "stmgcn_tpu.experiment",
+        "run": "stmgcn_tpu.experiment",
+        "Forecaster": "stmgcn_tpu.inference",
+        "STMGCN": "stmgcn_tpu.models",
+        "Trainer": "stmgcn_tpu.train",
+    }
+    if name in lazy:
+        import importlib
+
+        return getattr(importlib.import_module(lazy[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

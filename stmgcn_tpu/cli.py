@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use the Pallas block-CSR SpMM path for graph convs")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--out-dir", type=str, default=None)
+    p.add_argument("--val-ratio", type=float, default=None,
+                   help="validation fraction carved off the end of train "
+                        "(reference default 0.2)")
     p.add_argument("--horizon", type=int, default=None,
                    help="forecast steps per sample (default 1, next-step)")
     p.add_argument("--rows", type=int, default=None,
@@ -97,6 +100,12 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.data.dates = tuple(args.dates)
     if args.obs_len is not None:
         cfg.data.serial_len, cfg.data.daily_len, cfg.data.weekly_len = args.obs_len
+    if args.val_ratio is not None:
+        # val_ratio is the fraction carved off *train* (date path); the
+        # fraction path's val_frac is a share of *all* samples, so rescale
+        # by the train share to keep the flag's documented meaning.
+        cfg.data.val_ratio = args.val_ratio
+        cfg.data.val_frac = args.val_ratio * cfg.data.train_frac
     if args.horizon is not None:
         cfg.data.horizon = args.horizon
     if args.rows is not None:

@@ -1,0 +1,65 @@
+"""End-to-end coverage of the localpool and diffusion kernel families.
+
+The reference only ever runs chebyshev (its diffusion path crashes on the
+support-count assert, SURVEY.md §2 quirk 2); here all three families must
+train end-to-end.
+"""
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.cli import build_parser, config_from_args
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.experiment import build_supports, build_trainer
+
+
+def tiny(cfg):
+    cfg.data.rows = 4
+    cfg.data.n_timesteps = 24 * 7 * 2 + 48
+    cfg.train.epochs = 1
+    cfg.train.batch_size = 16
+    return cfg
+
+
+@pytest.mark.parametrize(
+    "kernel,K,n_supports",
+    [("localpool", 1, 1), ("chebyshev", 2, 3), ("random_walk_diffusion", 2, 5)],
+)
+def test_kernel_family_trains_end_to_end(tmp_path, kernel, K, n_supports):
+    cfg = tiny(preset("smoke"))
+    cfg.model.kernel_type = kernel
+    cfg.model.K = K
+    cfg.train.out_dir = str(tmp_path)
+    assert cfg.model.n_supports == n_supports
+    trainer = build_trainer(cfg, verbose=False)
+    assert trainer.supports.shape[1] == n_supports
+    hist = trainer.train()
+    assert np.isfinite(hist["train"][0])
+
+
+def test_forward_only_diffusion_supports():
+    cfg = tiny(preset("smoke"))
+    cfg.model.kernel_type = "random_walk_diffusion"
+    cfg.model.K = 2
+    cfg.model.bidirectional = False
+    assert cfg.model.n_supports == 3
+    from stmgcn_tpu.experiment import build_dataset
+
+    ds = build_dataset(cfg)
+    assert build_supports(cfg, ds).shape[1] == 3
+
+
+def test_cli_val_ratio_override():
+    args = build_parser().parse_args(["--preset", "smoke", "--val-ratio", "0.3"])
+    cfg = config_from_args(args)
+    assert cfg.data.val_ratio == 0.3 and cfg.data.val_frac == pytest.approx(0.21)
+
+
+def test_top_level_api_exports():
+    import stmgcn_tpu
+
+    assert callable(stmgcn_tpu.preset)
+    assert stmgcn_tpu.preset("smoke").name == "smoke"
+    assert stmgcn_tpu.Forecaster.__name__ == "Forecaster"
+    with pytest.raises(AttributeError):
+        stmgcn_tpu.nonexistent_thing

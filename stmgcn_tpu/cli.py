@@ -101,11 +101,13 @@ def config_from_args(args) -> "ExperimentConfig":
     if args.obs_len is not None:
         cfg.data.serial_len, cfg.data.daily_len, cfg.data.weekly_len = args.obs_len
     if args.val_ratio is not None:
-        # val_ratio is the fraction carved off *train* (date path); the
-        # fraction path's val_frac is a share of *all* samples, so rescale
-        # by the train share to keep the flag's documented meaning.
+        # val_ratio is the fraction carved off *train* (date path,
+        # Data_Container.py:106-108 semantics: train shrinks by the carve).
+        # Mirror that on the fraction path: the original train block splits
+        # into train' = train*(1-r) and val = train*r; test is untouched.
         cfg.data.val_ratio = args.val_ratio
-        cfg.data.val_frac = args.val_ratio * cfg.data.train_frac
+        cfg.data.val_frac = cfg.data.train_frac * args.val_ratio
+        cfg.data.train_frac = cfg.data.train_frac * (1.0 - args.val_ratio)
     if args.horizon is not None:
         cfg.data.horizon = args.horizon
     if args.rows is not None:

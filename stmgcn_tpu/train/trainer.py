@@ -160,8 +160,13 @@ class Trainer:
         return x, y, mask
 
     def _run_epoch(self, mode: str, train: bool) -> float:
-        """Sample-weighted mean loss over a mode (``Model_Trainer.py:43-44``)."""
-        total, count = 0.0, 0
+        """Sample-weighted mean loss over a mode (``Model_Trainer.py:43-44``).
+
+        Losses stay on device until the epoch ends — a per-batch
+        ``float(loss)`` would fence the pipeline every step and serialize
+        host batch prep with device compute.
+        """
+        losses, counts = [], []
         for batch in self.dataset.batches(
             mode,
             self.batch_size,
@@ -177,11 +182,13 @@ class Trainer:
                 )
             else:
                 loss, _ = self.step_fns.eval_step(self.params, self.supports, x, y, mask)
-            total += float(loss) * batch.n_real
-            count += batch.n_real
-        if count == 0:
+            losses.append(loss)
+            counts.append(batch.n_real)
+        if not counts:
             raise ValueError(f"no samples in mode {mode!r}")
-        return total / count
+        weights = np.asarray(counts, dtype=np.float32)
+        weighted = jnp.stack(losses) @ jnp.asarray(weights)
+        return float(weighted) / float(weights.sum())
 
     # -- public API -----------------------------------------------------
     def train(self) -> dict:

@@ -52,7 +52,17 @@ def test_forward_only_diffusion_supports():
 def test_cli_val_ratio_override():
     args = build_parser().parse_args(["--preset", "smoke", "--val-ratio", "0.3"])
     cfg = config_from_args(args)
-    assert cfg.data.val_ratio == 0.3 and cfg.data.val_frac == pytest.approx(0.21)
+    # the original 0.7 train block splits 0.49/0.21; test share untouched
+    assert cfg.data.val_ratio == 0.3
+    assert cfg.data.val_frac == pytest.approx(0.21)
+    assert cfg.data.train_frac == pytest.approx(0.49)
+    # large ratios stay valid on the fraction path (crashes before the fix)
+    args = build_parser().parse_args(["--preset", "smoke", "--val-ratio", "0.45"])
+    cfg = config_from_args(args)
+    from stmgcn_tpu.data.splits import fraction_splits
+
+    s = fraction_splits(1000, train=cfg.data.train_frac, validate=cfg.data.val_frac)
+    assert s.mode_len["train"] + s.mode_len["validate"] == pytest.approx(700, abs=2)
 
 
 def test_top_level_api_exports():

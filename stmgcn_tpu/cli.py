@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weight-decay", type=float, default=None)
     p.add_argument("--loss", choices=("mse", "mae", "huber"), default=None)
     p.add_argument("--patience", type=int, default=None)
+    p.add_argument("--top-k", type=int, default=None,
+                   help="keep the k best improvement snapshots (best_eN.ckpt) "
+                        "alongside best/latest")
     p.add_argument("--shuffle", action="store_true", default=None,
                    help="shuffle training batches (reference default is off)")
     p.add_argument("--m-graphs", type=int, default=None)
@@ -117,7 +120,8 @@ def config_from_args(args) -> "ExperimentConfig":
     for field, attr in [
         ("epochs", "epochs"), ("batch_size", "batch_size"), ("lr", "lr"),
         ("weight_decay", "weight_decay"), ("loss", "loss"),
-        ("patience", "patience"), ("seed", "seed"), ("out_dir", "out_dir"),
+        ("patience", "patience"), ("top_k", "top_k"), ("seed", "seed"),
+        ("out_dir", "out_dir"),
     ]:
         val = getattr(args, field)
         if val is not None:

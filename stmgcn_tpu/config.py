@@ -104,6 +104,7 @@ class TrainConfig:
     weight_decay: float = 1e-4
     loss: str = "mse"
     patience: int = 10
+    top_k: int = 1  # best improvement snapshots kept alongside best/latest
     shuffle: bool = False  # reference parity (Data_Container.py:122)
     seed: int = 0
     out_dir: str = "output"

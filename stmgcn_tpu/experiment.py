@@ -146,6 +146,7 @@ def build_trainer(
         n_epochs=t.epochs,
         batch_size=t.batch_size,
         patience=t.patience,
+        top_k=t.top_k,
         shuffle=t.shuffle,
         seed=t.seed,
         out_dir=t.out_dir,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import time
 from typing import Optional
 
@@ -63,6 +64,7 @@ class Trainer:
         shuffle: bool = False,
         seed: int = 0,
         out_dir: str = "output",
+        top_k: int = 1,
         placement=None,
         extra_meta: Optional[dict] = None,
         verbose: bool = True,
@@ -109,6 +111,10 @@ class Trainer:
         self.epoch = 0
         self.best_val = float("inf")
         self.patience_left = patience
+        if top_k < 1:
+            raise ValueError("top_k must be >= 1")
+        self.top_k = top_k
+        self._kept: list = []  # (val_loss, path) of retained epoch checkpoints
         # In a multi-host job every process runs the same deterministic loop;
         # only the lead process touches shared storage and stdout.
         self.is_lead = jax.process_index() == 0
@@ -145,6 +151,7 @@ class Trainer:
             "best_val": self.best_val,
             "patience_left": self.patience_left,
             "seed": self.seed,
+            "kept": self._kept,  # top-k retention state survives resume
         }
         if self.dataset.normalizer is not None:
             meta["normalizer"] = self.dataset.normalizer.to_dict()
@@ -213,6 +220,22 @@ class Trainer:
                 self.best_val = val_loss
                 self.patience_left = self.patience
                 self._save(self.best_path)
+                if self.top_k > 1 and self.is_lead:
+                    # best-k retention (SURVEY.md §5.d): keep the k best
+                    # improvement snapshots alongside best/latest; best.ckpt
+                    # was just written with identical content, so copy it
+                    path = os.path.join(self.out_dir, f"best_e{epoch}.ckpt")
+                    shutil.copyfile(self.best_path, path)
+                    # rank by (loss, newest-wins-on-ties) to match the
+                    # `val <= best` improvement rule
+                    self._kept.append((val_loss, -epoch, path))
+                    self._kept.sort()
+                    while len(self._kept) > self.top_k:
+                        _, _, stale = self._kept.pop()
+                        try:
+                            os.remove(stale)
+                        except OSError:
+                            pass
             else:
                 self.patience_left -= 1
                 self._log(
@@ -245,6 +268,7 @@ class Trainer:
         self.epoch = meta["epoch"]
         self.best_val = meta["best_val"]
         self.patience_left = meta["patience_left"]
+        self._kept = [tuple(entry) for entry in meta.get("kept", [])]
         return meta
 
     def test(self, modes=("train", "test"), checkpoint: Optional[str] = "best") -> dict:

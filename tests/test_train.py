@@ -199,6 +199,20 @@ class TestTrainer:
         assert tr.epoch == 5
         assert tr.best_val == 0.4
 
+    def test_top_k_checkpoint_retention(self, tmp_path, monkeypatch):
+        tr = small_trainer(tmp_path, epochs=50, patience=50)
+        tr.top_k = 2
+        script = iter([1.0, 0.9, 1.0, 0.7, 1.0, 0.5, 1.0, 0.3, 1.0, 0.2,
+                       1.0, 1.9, 1.0, 1.9, 1.0, 1.9])
+        monkeypatch.setattr(tr, "_run_epoch", lambda mode, train: next(script))
+        tr.n_epochs = 8
+        tr.train()
+        import glob
+
+        kept = sorted(glob.glob(str(tmp_path / "best_e*.ckpt")))
+        # five improvements (epochs 1-5); only the two best snapshots remain
+        assert [os.path.basename(p) for p in kept] == ["best_e4.ckpt", "best_e5.ckpt"]
+
     def test_resume_continues_epoch_count(self, tmp_path):
         tr = small_trainer(tmp_path, epochs=2)
         tr.train()

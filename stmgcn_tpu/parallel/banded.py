@@ -1,0 +1,101 @@
+"""Region-sharded banded support application with ring halo exchange.
+
+For banded graphs (grid cities: all support nonzeros within index distance
+``w``), GSPMD's default plan for a region-sharded graph convolution
+all-gathers the *entire* node axis of the signal on every device. This
+module implements the cheaper explicit plan (SURVEY.md §7, hard part 2):
+
+1. offline, each shard keeps only its **strip** of every support — its
+   ``n_local`` rows restricted to the ``n_local + 2w`` columns they can
+   touch (:func:`strip_decompose`);
+2. at apply time, each shard ``ppermute``s just ``w`` boundary rows of the
+   signal with its ring neighbors (:func:`~stmgcn_tpu.parallel.halo.
+   halo_exchange`) and contracts its strip locally — communication is
+   ``O(w)`` per shard instead of ``O(N)``.
+
+Numerically identical to the dense contraction
+``einsum('kij,bjf->kbif')`` — note the ``(K, B, N, F)`` output layout —
+for any support whose bandwidth fits the halo (validated at
+decomposition time).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stmgcn_tpu.parallel.halo import halo_exchange
+
+__all__ = ["bandwidth", "strip_decompose", "sharded_banded_apply"]
+
+
+def bandwidth(mat) -> int:
+    """Largest ``|i - j|`` with a nonzero entry (0 for diagonal/empty)."""
+    rows, cols = np.nonzero(np.asarray(mat))
+    if rows.size == 0:
+        return 0
+    return int(np.abs(rows - cols).max())
+
+
+def strip_decompose(supports, n_shards: int, halo: int) -> np.ndarray:
+    """Split ``(K, N, N)`` supports into per-shard row strips.
+
+    Returns ``(n_shards, K, n_local, n_local + 2*halo)`` where strip ``s``
+    holds rows ``[s*n_local, (s+1)*n_local)`` restricted to columns
+    ``[s*n_local - halo, (s+1)*n_local + halo)`` (zero-padded at the
+    boundaries). Raises if any support's bandwidth exceeds ``halo`` (the
+    exchange would silently drop neighbors) or if ``N`` is not divisible
+    by ``n_shards``.
+    """
+    supports = np.asarray(supports, dtype=np.float32)
+    k, n, _ = supports.shape
+    if n % n_shards:
+        raise ValueError(f"N={n} not divisible by {n_shards} shards")
+    n_local = n // n_shards
+    if halo > n_local:
+        raise ValueError(f"halo {halo} exceeds shard size {n_local}")
+    for ki in range(k):
+        bw = bandwidth(supports[ki])
+        if bw > halo:
+            raise ValueError(
+                f"support {ki} has bandwidth {bw} > halo {halo}; boundary "
+                "neighbors would be dropped"
+            )
+    padded = np.zeros((k, n, n + 2 * halo), dtype=np.float32)
+    padded[:, :, halo : halo + n] = supports
+    strips = np.empty((n_shards, k, n_local, n_local + 2 * halo), dtype=np.float32)
+    for s in range(n_shards):
+        lo = s * n_local
+        strips[s] = padded[:, lo : lo + n_local, lo : lo + n_local + 2 * halo]
+    return strips
+
+
+def sharded_banded_apply(
+    mesh: Mesh, strips, x, halo: int, axis_name: str = "region"
+) -> jnp.ndarray:
+    """``out[k,b,i,f] = sum_j A_k[i,j] x[b,j,f]`` with the node axis sharded.
+
+    ``strips``: :func:`strip_decompose` output; ``x``: ``(B, N, F)``.
+    Returns ``(K, B, N, F)`` with ``N`` sharded over ``axis_name``; each
+    shard exchanges only ``halo`` boundary rows.
+    """
+
+    def local(strip, x_loc):
+        # strip: (1, K, nl, nl+2h) — leading shard axis; x_loc: (B, nl, F)
+        if halo > 0:
+            xp = x_loc.swapaxes(0, 1)
+            xp = halo_exchange(xp, halo, axis_name)  # (nl+2h, B, F)
+        else:  # diagonal-only supports: nothing to exchange
+            xp = x_loc.swapaxes(0, 1)
+        # contract local rows against the padded neighborhood
+        return jnp.einsum("knm,mbf->kbnf", strip[0], xp)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name, None, None, None), P(None, axis_name, None)),
+        out_specs=P(None, None, axis_name, None),
+    )
+    return fn(jnp.asarray(strips), jnp.asarray(x))

@@ -25,6 +25,7 @@ from typing import Optional
 ROWS = int(os.environ.get("STMGCN_BENCH_ROWS", 16))
 SERIAL, DAILY, WEEKLY = 10, 1, 1
 BATCH = int(os.environ.get("STMGCN_BENCH_BATCH", 64))
+DTYPE = os.environ.get("STMGCN_BENCH_DTYPE", "float32")  # or bfloat16
 WARMUP = int(os.environ.get("STMGCN_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 30))
 
@@ -85,6 +86,10 @@ def main() -> None:
     data = synthetic_dataset(rows=ROWS, n_timesteps=24 * 7 * 2 + 4 * BATCH, seed=0)
     dataset = DemandDataset(data, WindowSpec(SERIAL, DAILY, WEEKLY, 24))
     supports = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    import jax.numpy as jnp
+
+    if DTYPE not in ("float32", "bfloat16"):
+        raise ValueError(f"STMGCN_BENCH_DTYPE must be float32 or bfloat16, got {DTYPE!r}")
     model = STMGCN(
         m_graphs=3,
         n_supports=3,
@@ -93,12 +98,11 @@ def main() -> None:
         lstm_hidden_dim=64,
         lstm_num_layers=3,
         gcn_hidden_dim=64,
+        dtype=jnp.bfloat16 if DTYPE == "bfloat16" else None,
     )
     fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
 
     batch = next(dataset.batches("train", BATCH, pad_last=True))
-    import jax.numpy as jnp
-
     sup = jnp.asarray(supports)
     x = jnp.asarray(batch.x)
     y = jnp.asarray(batch.y)
@@ -115,22 +119,26 @@ def main() -> None:
 
     value = region_timesteps_per_sec(BATCH, seq_len, dataset.n_nodes, timer.mean)
 
+    # vs_baseline only compares like dtypes: the stored torch anchor is fp32
     vs_baseline = None
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "benchmarks", "baseline.json")
-    if os.path.exists(baseline_path):
+    if DTYPE == "float32" and os.path.exists(baseline_path):
         with open(baseline_path) as f:
             base = json.load(f)
         ref = base.get("torch_cpu_region_ts_per_sec")
         if ref:
             vs_baseline = value / ref
 
-    print(json.dumps({
+    record = {
         "metric": "region-timesteps/sec/chip",
         "value": round(value, 1),
         "unit": "region-timesteps/s",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline is not None else None,
-    }))
+    }
+    if DTYPE != "float32":
+        record["dtype"] = DTYPE
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

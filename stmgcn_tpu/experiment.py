@@ -8,7 +8,6 @@ distributed runners) assembles experiments through these functions.
 
 from __future__ import annotations
 
-import numpy as np
 
 from stmgcn_tpu.config import ExperimentConfig
 from stmgcn_tpu.data import (

@@ -223,6 +223,15 @@ class TestTrainer:
         assert len(hist["train"]) == 2  # epochs 3 and 4 only
         assert tr2.epoch == 4
 
+    def test_same_seed_reproduces_trajectory(self, tmp_path):
+        a = small_trainer(tmp_path / "a", epochs=2)
+        hist_a = a.train()
+        b = small_trainer(tmp_path / "b", epochs=2)
+        hist_b = b.train()
+        np.testing.assert_array_equal(hist_a["train"], hist_b["train"])
+        np.testing.assert_array_equal(hist_a["validate"], hist_b["validate"])
+        jax.tree.map(np.testing.assert_array_equal, a.params, b.params)
+
     def test_test_reports_denormalized_metrics(self, tmp_path):
         tr = small_trainer(tmp_path, epochs=1)
         tr.train()

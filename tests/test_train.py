@@ -158,7 +158,7 @@ class TestCheckpoint:
             load_checkpoint(str(path))
 
 
-def small_trainer(tmp_path, epochs=3, patience=10, **model_kw):
+def small_trainer(tmp_path, epochs=3, patience=10, shuffle=False, **model_kw):
     data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 60, seed=1)
     dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24))
     from stmgcn_tpu.ops import SupportConfig
@@ -167,7 +167,8 @@ def small_trainer(tmp_path, epochs=3, patience=10, **model_kw):
     model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
                    lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8, **model_kw)
     return Trainer(model, dataset, sup, n_epochs=epochs, patience=patience,
-                   batch_size=16, out_dir=str(tmp_path), verbose=False)
+                   shuffle=shuffle, batch_size=16, out_dir=str(tmp_path),
+                   verbose=False)
 
 
 class TestTrainer:
@@ -224,9 +225,11 @@ class TestTrainer:
         assert tr2.epoch == 4
 
     def test_same_seed_reproduces_trajectory(self, tmp_path):
-        a = small_trainer(tmp_path / "a", epochs=2)
+        # shuffle=True exercises the seeded (seed, epoch) permutation stream —
+        # the path a reproducibility regression would actually hit
+        a = small_trainer(tmp_path / "a", epochs=2, shuffle=True)
         hist_a = a.train()
-        b = small_trainer(tmp_path / "b", epochs=2)
+        b = small_trainer(tmp_path / "b", epochs=2, shuffle=True)
         hist_b = b.train()
         np.testing.assert_array_equal(hist_a["train"], hist_b["train"])
         np.testing.assert_array_equal(hist_a["validate"], hist_b["validate"])

@@ -1,21 +1,35 @@
 #!/usr/bin/env python
-"""Benchmark the flagship training step; prints ONE JSON line.
+"""Benchmark the flagship training step; prints ONE JSON line and exits 0.
 
 Metric: region-timesteps/sec/chip — ``batch * seq_len * n_nodes`` demand
 points advanced per second of steady-state training step (forward + grad +
-Adam update), on whatever single chip JAX exposes.
+Adam update), on whatever single chip JAX exposes. The record also carries
+``mfu`` (analytic-FLOPs model utilization vs the chip's bf16 peak — see
+``stmgcn_tpu/utils/flops.py``) and, by default, a bf16 sub-record next to
+the fp32 headline.
 
 ``vs_baseline`` compares against the reference-equivalent PyTorch
-implementation's throughput at identical shapes (see
-``benchmarks/torch_baseline.py``; the reference repo itself ships no
-numbers or data — SURVEY.md §6). The stored baseline in
-``benchmarks/baseline.json`` records the hardware it was measured on.
+implementation's throughput at identical shapes (the reference repo itself
+ships no numbers or data — SURVEY.md §6); the anchor's provenance (device,
+threads, value — it is a single-thread CPU torch run, NOT a like-for-like
+accelerator) is embedded in the printed record as ``baseline``.
+
+Failure policy: this script never fails closed on *environment* trouble.
+A wedged TPU tunnel is probed with retries + backoff; on persistent
+failure it falls back to a CPU measurement (labeled ``platform:
+cpu-fallback`` with an ``error`` field) so the driver parses a real record
+with ``value > 0`` whenever the configuration is valid. Invalid operator
+configuration (bad ``STMGCN_BENCH_DTYPE``) exits nonzero instead; any
+other unexpected exception emits a ``value: 0.0`` record with the error
+attached rather than producing no parsable line at all.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
+import time
 from typing import Optional
 
 # Benchmark operating point ("Didi-Chengdu, 12-step" scale, BASELINE.json):
@@ -25,80 +39,88 @@ from typing import Optional
 ROWS = int(os.environ.get("STMGCN_BENCH_ROWS", 16))
 SERIAL, DAILY, WEEKLY = 10, 1, 1
 BATCH = int(os.environ.get("STMGCN_BENCH_BATCH", 64))
-DTYPE = os.environ.get("STMGCN_BENCH_DTYPE", "float32")  # or bfloat16
+DTYPE = os.environ.get("STMGCN_BENCH_DTYPE", "both")  # float32 | bfloat16 | both
 WARMUP = int(os.environ.get("STMGCN_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 30))
+LSTM_HIDDEN, LSTM_LAYERS, GCN_HIDDEN, M_GRAPHS, K_SUPPORTS = 64, 3, 64, 3, 3
 
 
-def _backend_watchdog(seconds: Optional[int] = None) -> None:
-    """Fail fast (to stderr, nonzero exit) if backend init hangs.
+def _emit(record: dict) -> None:
+    """Print the one-line JSON record and exit 0 (driver parses stdout)."""
+    print(json.dumps(record))
+    sys.exit(0)
+
+
+def _probe_backend() -> Optional[str]:
+    """Probe backend init in a killable child; retry with backoff.
 
     A wedged TPU tunnel can block the first device op indefinitely *inside
     native code* (signal handlers never run), so the probe happens in a
-    child process the parent can time out and kill. Costs one extra
-    backend startup per run; ``STMGCN_BENCH_WATCHDOG=0`` disables it on
-    trusted hosts, any other integer overrides the timeout (seconds).
+    child process the parent can time out and kill. Returns None when the
+    backend is healthy, else the final error string.
+    ``STMGCN_BENCH_WATCHDOG=0`` disables it; any other integer scales the
+    first attempt's timeout (later attempts grow: t, 2t, 3t).
     """
     import subprocess
-    import sys
 
-    if seconds is None:
-        seconds = int(os.environ.get("STMGCN_BENCH_WATCHDOG", 180))
-    if seconds <= 0:
-        return
+    base = int(os.environ.get("STMGCN_BENCH_WATCHDOG", 45))
+    if base <= 0:
+        return None
     probe = (
         "import jax, jax.numpy as jnp; "
         "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()"
     )
-    try:
-        subprocess.run(
-            [sys.executable, "-c", probe],
-            timeout=seconds,
-            check=True,
-            capture_output=True,
-        )
-    except subprocess.TimeoutExpired:
-        print(
-            f"bench: backend did not initialize within {seconds}s "
-            "(TPU tunnel unavailable?)",
-            file=sys.stderr,
-        )
-        sys.exit(2)
-    except subprocess.CalledProcessError as e:
-        print(
-            "bench: backend probe failed:\n" + e.stderr.decode()[-500:],
-            file=sys.stderr,
-        )
-        sys.exit(2)
+    err = "backend probe never ran"
+    timeouts = (base, 2 * base, 3 * base)
+    for attempt, timeout_s in enumerate(timeouts):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=timeout_s,
+                check=True,
+                capture_output=True,
+            )
+            return None
+        except subprocess.TimeoutExpired:
+            err = f"backend did not initialize within {timeout_s}s (attempt {attempt + 1})"
+        except subprocess.CalledProcessError as e:
+            err = "backend probe failed: " + e.stderr.decode()[-300:]
+        if attempt + 1 < len(timeouts):
+            print(f"bench: {err}; retrying", file=sys.stderr)
+            time.sleep(2**attempt)
+    return err
 
 
-def main() -> None:
-    _backend_watchdog()
+def _measure(dtype: str, warmup: int, iters: int) -> dict:
+    """Measure the training step at the canonical point in one dtype."""
     import jax
-    import numpy as np
+    import jax.numpy as jnp
 
     from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
     from stmgcn_tpu.models import STMGCN
     from stmgcn_tpu.ops import SupportConfig
     from stmgcn_tpu.train import make_optimizer, make_step_fns
+    from stmgcn_tpu.utils import (
+        StepTimer,
+        device_peak_flops,
+        mfu,
+        region_timesteps_per_sec,
+        stmgcn_step_flops,
+    )
 
     seq_len = SERIAL + DAILY + WEEKLY
     data = synthetic_dataset(rows=ROWS, n_timesteps=24 * 7 * 2 + 4 * BATCH, seed=0)
     dataset = DemandDataset(data, WindowSpec(SERIAL, DAILY, WEEKLY, 24))
     supports = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
-    import jax.numpy as jnp
-
-    if DTYPE not in ("float32", "bfloat16"):
-        raise ValueError(f"STMGCN_BENCH_DTYPE must be float32 or bfloat16, got {DTYPE!r}")
     model = STMGCN(
-        m_graphs=3,
-        n_supports=3,
+        m_graphs=M_GRAPHS,
+        n_supports=K_SUPPORTS,
         seq_len=seq_len,
         input_dim=dataset.n_feats,
-        lstm_hidden_dim=64,
-        lstm_num_layers=3,
-        gcn_hidden_dim=64,
-        dtype=jnp.bfloat16 if DTYPE == "bfloat16" else None,
+        lstm_hidden_dim=LSTM_HIDDEN,
+        lstm_num_layers=LSTM_LAYERS,
+        gcn_hidden_dim=GCN_HIDDEN,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else None,
     )
     fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
 
@@ -109,37 +131,142 @@ def main() -> None:
     mask = jnp.ones(BATCH, jnp.float32)
     params, opt_state = fns.init(jax.random.key(0), sup, x)
 
-    from stmgcn_tpu.utils import StepTimer, region_timesteps_per_sec
-
-    timer = StepTimer(warmup=WARMUP)
-    for _ in range(WARMUP + ITERS):
+    timer = StepTimer(warmup=warmup)
+    for _ in range(warmup + iters):
         params, opt_state, loss = timer.measure(
             fns.train_step, params, opt_state, sup, x, y, mask
         )
 
-    value = region_timesteps_per_sec(BATCH, seq_len, dataset.n_nodes, timer.mean)
+    step_s = timer.mean
+    flops = stmgcn_step_flops(
+        batch=BATCH,
+        seq_len=seq_len,
+        n_nodes=dataset.n_nodes,
+        n_feats=dataset.n_feats,
+        m_graphs=M_GRAPHS,
+        n_supports=K_SUPPORTS,
+        lstm_hidden_dim=LSTM_HIDDEN,
+        lstm_num_layers=LSTM_LAYERS,
+        gcn_hidden_dim=GCN_HIDDEN,
+    )
+    peak = device_peak_flops()
+    util = mfu(flops, step_s, peak)
+    return {
+        "value": round(region_timesteps_per_sec(BATCH, seq_len, dataset.n_nodes, step_s), 1),
+        "step_ms": round(step_s * 1e3, 3),
+        "mfu": round(util, 4) if util is not None else None,
+        "model_flops_per_step": flops,
+        "peak_flops_bf16": peak,
+        "final_loss": float(loss),
+    }
+
+
+def main() -> None:
+    if DTYPE not in ("float32", "bfloat16", "both"):
+        raise SystemExit(
+            f"STMGCN_BENCH_DTYPE must be float32|bfloat16|both, got {DTYPE!r}"
+        )
+    from stmgcn_tpu.utils import force_host_platform
+
+    # STMGCN_BENCH_PLATFORM=cpu pins the host platform (skipping the TPU
+    # probe entirely) — for validating the full success path on hosts
+    # where the axon plugin would otherwise be dialed.
+    pinned = os.environ.get("STMGCN_BENCH_PLATFORM")
+    if pinned:
+        force_host_platform(pinned)
+        probe_err = None
+    else:
+        probe_err = _probe_backend()
+    if probe_err is not None:
+        # TPU unreachable: measure on the host CPU instead of recording nothing.
+        force_host_platform("cpu")
+
+    dtypes = ("float32", "bfloat16") if DTYPE == "both" else (DTYPE,)
+    if probe_err is not None:
+        dtypes = ("float32",)  # CPU fallback: keep it cheap
+
+    results = {}
+    measure_err = None
+    for d in dtypes:
+        warmup, iters = (1, 3) if probe_err is not None else (WARMUP, ITERS)
+        try:
+            results[d] = _measure(d, warmup, iters)
+        except Exception as e:  # keep surviving dtypes: one bad leg must not
+            measure_err = f"{d}: {type(e).__name__}: {e}"  # void the record
+            print(f"bench: measurement failed for {measure_err}", file=sys.stderr)
+    if not results:
+        raise RuntimeError(measure_err or "no dtype measured")
+
+    primary = results.get("float32") or next(iter(results.values()))
 
     # vs_baseline only compares like dtypes: the stored torch anchor is fp32
     vs_baseline = None
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "benchmarks", "baseline.json")
-    if DTYPE == "float32" and os.path.exists(baseline_path):
+    baseline = None
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "baseline.json"
+    )
+    if os.path.exists(baseline_path):
         with open(baseline_path) as f:
             base = json.load(f)
         ref = base.get("torch_cpu_region_ts_per_sec")
-        if ref:
-            vs_baseline = value / ref
+        baseline = {
+            "device": base.get("device"),
+            "threads": base.get("threads"),
+            "value": round(ref, 1) if ref else None,
+        }
+        shapes = base.get("shapes", {})
+        shapes_match = (
+            shapes.get("rows") == ROWS
+            and shapes.get("batch") == BATCH
+            and shapes.get("seq_len") == SERIAL + DAILY + WEEKLY
+        )
+        if ref and "float32" in results and shapes_match:
+            vs_baseline = results["float32"]["value"] / ref
 
+    import math
+
+    import jax
+
+    loss = primary["final_loss"]
     record = {
         "metric": "region-timesteps/sec/chip",
-        "value": round(value, 1),
+        "value": primary["value"],
         "unit": "region-timesteps/s",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline is not None else None,
+        "dtype": "float32" if "float32" in results else next(iter(results)),
+        "step_ms": primary["step_ms"],
+        "mfu": primary["mfu"],
+        "device": jax.devices()[0].device_kind,
+        "model_flops_per_step": primary["model_flops_per_step"],
+        "peak_flops_bf16": primary["peak_flops_bf16"],
+        # bare NaN/Inf would make the one output line unparsable to strict
+        # JSON readers — exactly the failure this script must never have
+        "final_loss": loss if math.isfinite(loss) else None,
+        "baseline": baseline,
     }
-    if DTYPE != "float32":
-        record["dtype"] = DTYPE
-    print(json.dumps(record))
+    if "bfloat16" in results:
+        r = results["bfloat16"]
+        record["bf16"] = {"value": r["value"], "step_ms": r["step_ms"], "mfu": r["mfu"]}
+    if probe_err is not None:
+        record["platform"] = "cpu-fallback"
+        record["error"] = probe_err
+    elif measure_err is not None:
+        record["error"] = measure_err
+    _emit(record)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # never fail closed: the driver needs a parsable line
+        _emit(
+            {
+                "metric": "region-timesteps/sec/chip",
+                "value": 0.0,
+                "unit": "region-timesteps/s",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        )

@@ -1,5 +1,15 @@
-"""Utilities: profiling/timing and numeric-debugging helpers."""
+"""Utilities: profiling/timing, FLOPs/MFU accounting, numeric debugging."""
 
+from stmgcn_tpu.utils.flops import device_peak_flops, mfu, stmgcn_step_flops
+from stmgcn_tpu.utils.platform import force_host_platform
 from stmgcn_tpu.utils.profiling import StepTimer, region_timesteps_per_sec, trace
 
-__all__ = ["StepTimer", "region_timesteps_per_sec", "trace"]
+__all__ = [
+    "StepTimer",
+    "device_peak_flops",
+    "force_host_platform",
+    "mfu",
+    "region_timesteps_per_sec",
+    "stmgcn_step_flops",
+    "trace",
+]

@@ -1,0 +1,104 @@
+"""Analytic FLOPs model for the ST-MGCN training step + TPU peak lookup.
+
+MFU (model FLOPs utilization) = analytic-model FLOPs / step time / chip
+peak — the honest single-chip evidence that the chip is busy, as opposed to
+a throughput number whose anchor ran on different hardware.
+
+The FLOPs model counts the multiply-accumulate work of the reference's hot
+path (each term cites the reference op it models; SURVEY.md §3.2):
+
+- per-branch temporal graph conv in the gate: K support matmuls over the
+  length-T history-as-features (``/root/reference/GCN.py:34-36`` inside
+  ``STMGCN.py:40``) plus the ``(K*T, T)`` weight contraction
+  (``GCN.py:39``);
+- the two gate FC applications (``STMGCN.py:43``, eq. 8);
+- the globally-shared L-layer LSTM over ``B*N`` folded rows
+  (``STMGCN.py:47-48``): 4 gates, input + recurrent matmuls per step;
+- the per-branch output graph conv on the LSTM state (``STMGCN.py:114``);
+- the fusion head (``STMGCN.py:118``).
+
+Elementwise work (activations, gating, residuals, Adam update) is excluded
+— it is HBM-bound, not MXU-bound, and inflating the numerator would
+overstate MFU. The backward pass is modeled as 2x the forward (the standard
+dense-layer accounting: one matmul each for input and weight gradients per
+forward matmul), giving the usual 3x total.
+
+Peak lookup: per-JAX-device bf16 MXU peaks. On TPU, XLA's *default* f32
+``dot_general`` precision multiplies in bf16 (with f32 accumulation), so
+the bf16 peak is the correct denominator for both dtypes measured by
+``bench.py``; a documented conservative choice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["stmgcn_step_flops", "device_peak_flops", "mfu"]
+
+
+def stmgcn_step_flops(
+    batch: int,
+    seq_len: int,
+    n_nodes: int,
+    n_feats: int,
+    m_graphs: int,
+    n_supports: int,
+    lstm_hidden_dim: int,
+    lstm_num_layers: int,
+    gcn_hidden_dim: int,
+    horizon: int = 1,
+    backward: bool = True,
+) -> float:
+    """Matmul FLOPs (2 * MACs) of one training (or forward) step."""
+    B, T, N, C = batch, seq_len, n_nodes, n_feats
+    K, H, G, L, M = n_supports, lstm_hidden_dim, gcn_hidden_dim, lstm_num_layers, m_graphs
+
+    # Gate: K supports x (N,N)@(N,T) per sample, then (B,N,K*T)@(K*T,T),
+    # then the FC pair (B,T)@(T,T) twice (shared or not, same FLOPs).
+    gate_gconv = 2.0 * K * B * N * N * T + 2.0 * B * N * (K * T) * T
+    gate_fc = 2 * (2.0 * B * T * T)
+    # LSTM: per folded row (B*N) per step, 4 gates of input+recurrent matmul.
+    lstm = (
+        B * N * T * (8.0 * (C + H) * H + (L - 1) * 8.0 * (H + H) * H)
+    )
+    # Output graph conv on the (B, N, H) LSTM state.
+    out_gconv = 2.0 * K * B * N * N * H + 2.0 * B * N * (K * H) * G
+    branch = gate_gconv + gate_fc + lstm + out_gconv
+    head = 2.0 * B * N * G * (horizon * C)
+    fwd = M * branch + head
+    return 3.0 * fwd if backward else fwd
+
+
+#: Per-JAX-device bf16 peak FLOP/s by `device_kind` substring (first match
+#: wins; ordered most-specific first). Sources: published TPU specs.
+_TPU_PEAK_BF16 = (
+    ("v6", 918e12),  # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 61.5e12),  # per core (a v3 JAX device is one of 2 chip cores)
+    ("v2", 22.5e12),  # per core
+)
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """bf16 peak FLOP/s of a JAX device; None when unknown (e.g. CPU)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" not in kind and device.platform != "tpu":
+        return None
+    for needle, peak in _TPU_PEAK_BF16:
+        if needle in kind:
+            return peak
+    return None
+
+
+def mfu(model_flops: float, step_seconds: float, peak_flops: Optional[float]) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1]; None when the peak is unknown."""
+    if peak_flops is None or step_seconds <= 0:
+        return None
+    return model_flops / step_seconds / peak_flops

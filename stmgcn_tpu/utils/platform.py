@@ -1,0 +1,44 @@
+"""Pin the JAX host platform before backend initialization.
+
+The axon TPU plugin in this image **ignores the ``JAX_PLATFORMS`` env
+var** — only the ``jax_platforms`` config flag sticks — and its backend
+init can hang indefinitely on a wedged tunnel. Every caller that needs a
+guaranteed-CPU (or guaranteed-virtual-multi-device) JAX therefore routes
+through this one helper instead of hand-copying the workaround.
+
+Must run **before** the JAX backend initializes (any ``jax.devices()`` /
+first op): both ``XLA_FLAGS`` and the platform choice are read once at
+backend init and silently ignored afterwards.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+__all__ = ["force_host_platform"]
+
+
+def force_host_platform(platform: str = "cpu", n_devices: Optional[int] = None) -> None:
+    """Pin the platform; optionally set the virtual host-device count.
+
+    ``n_devices`` overrides any existing
+    ``--xla_force_host_platform_device_count`` in ``XLA_FLAGS`` (a smaller
+    preexisting value would otherwise win and starve multi-device runs).
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(r"--xla_force_host_platform_device_count=\d+", opt, flags)
+        else:
+            flags = (flags + " " + opt).strip()
+        os.environ["XLA_FLAGS"] = flags
+    # The env var is honored by stock JAX (harmless under axon, which
+    # ignores it); the config flag is what actually sticks here.
+    os.environ["JAX_PLATFORMS"] = platform
+
+    import jax
+
+    jax.config.update("jax_platforms", platform)

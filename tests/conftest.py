@@ -1,26 +1,21 @@
 """Test harness configuration.
 
-Forces JAX onto a virtual 8-device CPU platform *before* jax is imported so
-distributed/sharding tests run without TPU hardware — the standard JAX trick
-(`--xla_force_host_platform_device_count`) substituting for the multi-device
-fixtures the reference never had (SURVEY.md §4).
+Forces JAX onto a virtual 8-device CPU platform *before* any backend
+initialization so distributed/sharding tests run without TPU hardware —
+the standard JAX trick (``--xla_force_host_platform_device_count``)
+substituting for the multi-device fixtures the reference never had
+(SURVEY.md §4). The axon-plugin platform gotcha lives in one place:
+``stmgcn_tpu/utils/platform.py``.
 """
 
 import os
 import sys
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 # Make the repo importable without installation.
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-# The axon TPU plugin in this image ignores the JAX_PLATFORMS env var; the
-# config flag does stick. Must run before any backend initialization.
-import jax  # noqa: E402
+from stmgcn_tpu.utils import force_host_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_host_platform("cpu", n_devices=8)

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use the Pallas block-CSR SpMM path for graph convs")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--out-dir", type=str, default=None)
+    p.add_argument("--normalize", choices=("minmax", "std", "none"), default=None,
+                   help="demand normalization (reference parity: minmax to "
+                        "[-1,1]; stats travel inside checkpoints either way)")
     p.add_argument("--val-ratio", type=float, default=None,
                    help="validation fraction carved off the end of train "
                         "(reference default 0.2)")
@@ -113,6 +116,8 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.data.train_frac = cfg.data.train_frac * (1.0 - args.val_ratio)
     if args.horizon is not None:
         cfg.data.horizon = args.horizon
+    if args.normalize is not None:
+        cfg.data.normalize = args.normalize
     if args.rows is not None:
         cfg.data.rows = args.rows
     if args.timesteps is not None:
@@ -151,17 +156,11 @@ def main(argv=None) -> int:
     # Platform selection must land before the JAX backend initializes (no
     # jax array op has run yet at this point).
     if args.virtual_devices:
-        import os
-
-        flags = os.environ.get("XLA_FLAGS", "")
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={args.virtual_devices}"
-        ).strip()
         args.platform = args.platform or "cpu"
     if args.platform:
-        import jax
+        from stmgcn_tpu.utils import force_host_platform
 
-        jax.config.update("jax_platforms", args.platform)
+        force_host_platform(args.platform, n_devices=args.virtual_devices)
     if args.debug_nans:
         import jax
 

@@ -46,6 +46,8 @@ class DataConfig:
     daily_len: int = 1
     weekly_len: int = 1
     horizon: int = 1  # forecast steps per sample (1 = reference parity)
+    #: "minmax" (reference parity, Data_Container.py:21) | "std" | "none"
+    normalize: str = "minmax"
     dates: Optional[tuple] = None  # (train_s, train_e, test_s, test_e) MMDD
     val_ratio: float = 0.2
     year: int = 2017

@@ -26,7 +26,7 @@ from typing import Iterator, Sequence, Union
 import numpy as np
 
 from stmgcn_tpu.data.loader import DemandData
-from stmgcn_tpu.data.normalize import MinMaxNormalizer
+from stmgcn_tpu.data.normalize import MinMaxNormalizer, StdNormalizer
 from stmgcn_tpu.data.splits import MODES, SplitSpec, fraction_splits
 from stmgcn_tpu.data.windowing import WindowSpec, sliding_windows
 
@@ -56,13 +56,24 @@ class DemandDataset:
     samples are the concatenation of that mode's slice from every city.
     """
 
+    #: normalizer selected per ``normalize=`` kind (None = raw values)
+    _NORMALIZERS = {"minmax": MinMaxNormalizer, "std": StdNormalizer, "none": None}
+
     def __init__(
         self,
         data: Union[DemandData, Sequence[DemandData]],
         window: WindowSpec,
         split: SplitSpec | None = None,
-        normalize: bool = True,
+        normalize: Union[bool, str] = "minmax",
     ):
+        # bool accepted for back-compat: True = reference-parity min-max
+        # (Data_Container.py:21), False = raw values.
+        if isinstance(normalize, bool):
+            normalize = "minmax" if normalize else "none"
+        if normalize not in self._NORMALIZERS:
+            raise ValueError(
+                f"normalize must be one of {sorted(self._NORMALIZERS)}, got {normalize!r}"
+            )
         datas = list(data) if isinstance(data, (list, tuple)) else [data]
         if not datas:
             raise ValueError("need at least one city")
@@ -83,13 +94,16 @@ class DemandDataset:
         self.adjs = datas[0].adjs
         self._mode_cache: dict = {}
 
+        norm_cls = self._NORMALIZERS[normalize]
         stacked = np.concatenate([d.demand for d in datas], axis=0)
-        self.normalizer = MinMaxNormalizer.fit(stacked) if normalize else None
+        self.normalizer = norm_cls.fit(stacked) if norm_cls is not None else None
 
         self._xs, self._ys = [], []
         for d in datas:
             demand = (
-                self.normalizer.transform(d.demand) if normalize else d.demand
+                self.normalizer.transform(d.demand)
+                if self.normalizer is not None
+                else d.demand
             ).astype(np.float32)
             x, y = sliding_windows(demand, window)
             self._xs.append(x)

@@ -67,7 +67,9 @@ def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
         )
     else:
         split = fraction_splits(n_samples, train=d.train_frac, validate=d.val_frac)
-    return DemandDataset(cities if len(cities) > 1 else cities[0], window, split)
+    return DemandDataset(
+        cities if len(cities) > 1 else cities[0], window, split, normalize=d.normalize
+    )
 
 
 def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):

@@ -193,6 +193,14 @@ def spmm(bs: BlockSparse, x: jnp.ndarray, interpret: Optional[bool] = None) -> j
     """``A @ x`` for a block-sparse support; ``x`` is ``(N, M)``.
 
     ``interpret`` defaults to True off-TPU (CPU tests) and False on TPU.
+
+    .. warning:: Gradients flow only to ``x``. The support's block values
+       (``bs.data``/``bs.data_t``) get **zero cotangents by design** —
+       supports are offline constants here (built once from adjacency,
+       ``GCN.py:50-97``-equivalent). If supports ever become trainable, do
+       NOT use this path: it would train silently with zero support
+       gradients where the dense einsum path produces real ones. Extend
+       ``_spmm_bwd`` with a ``dA = g @ x^T`` block-gather first.
     """
     if x.ndim != 2:
         raise ValueError(f"x must be (N, M), got {x.shape}")

@@ -15,6 +15,14 @@ with the same control semantics, restructured for JAX:
   quirk 5);
 - per-epoch JSONL records land in ``<out_dir>/history.jsonl`` in addition
   to stdout prints (SURVEY.md §5.e).
+
+Multi-host note: only the lead process *writes* ``out_dir``, but
+``restore()`` and ``test()`` *read* checkpoints on **every** process —
+``out_dir`` must therefore live on a filesystem shared across hosts (GCS
+fuse, NFS). On pods with host-local disks, non-lead processes would fail
+to open the file (or silently read a stale copy); broadcasting restored
+state from process 0 instead is a possible future extension
+(``jax.experimental.multihost_utils``).
 """
 
 from __future__ import annotations
@@ -260,7 +268,11 @@ class Trainer:
         return history
 
     def restore(self, path: Optional[str] = None) -> dict:
-        """Load a checkpoint (default: latest) into the live trainer state."""
+        """Load a checkpoint (default: latest) into the live trainer state.
+
+        Reads on every process — multi-host jobs need ``out_dir`` on a
+        shared filesystem (see the module docstring).
+        """
         path = path or self.latest_path
         meta, params, opt_state = load_checkpoint(path, self.params, self.opt_state)
         self.params = self.placement.put(params, "state")

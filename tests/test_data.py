@@ -204,6 +204,35 @@ class TestPipeline:
             dd.denormalize(y), raw.demand[168 : 168 + len(y)], rtol=1e-5, atol=1e-4
         )
 
+    def test_normalize_kind_selection(self):
+        raw = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 3, seed=3)
+        std = DemandDataset(raw, WindowSpec(3, 1, 1, 24), normalize="std")
+        from stmgcn_tpu.data.normalize import StdNormalizer
+
+        assert isinstance(std.normalizer, StdNormalizer)
+        _, y = std.arrays("train")
+        np.testing.assert_allclose(
+            std.denormalize(y), raw.demand[168 : 168 + len(y)], rtol=1e-4, atol=1e-3
+        )
+        none = DemandDataset(raw, WindowSpec(3, 1, 1, 24), normalize="none")
+        assert none.normalizer is None
+        _, y_raw = none.arrays("train")
+        np.testing.assert_allclose(y_raw, raw.demand[168 : 168 + len(y_raw)], rtol=1e-6)
+        # bool back-compat + bad kind fails loudly
+        assert DemandDataset(raw, WindowSpec(3, 1, 1, 24), normalize=False).normalizer is None
+        with np.testing.assert_raises(ValueError):
+            DemandDataset(raw, WindowSpec(3, 1, 1, 24), normalize="zscore")
+
+    def test_normalize_config_reaches_dataset(self):
+        from stmgcn_tpu.config import preset
+        from stmgcn_tpu.data.normalize import StdNormalizer
+        from stmgcn_tpu.experiment import build_dataset
+
+        cfg = preset("smoke")
+        cfg.data.n_timesteps = 24 * 7 * 2
+        cfg.data.normalize = "std"
+        assert isinstance(build_dataset(cfg).normalizer, StdNormalizer)
+
     def test_batch_iteration_counts(self):
         dd = self.make()
         n = dd.split.mode_len["train"]

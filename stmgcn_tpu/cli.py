@@ -77,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--virtual-devices", type=int, default=None, metavar="N",
                    help="emulate N devices on CPU (for mesh dry-runs; implies "
                         "--platform cpu)")
+    p.add_argument("--region-strategy", choices=("gspmd", "banded", "auto"),
+                   default=None,
+                   help="region-sharded conv plan: XLA's automatic (gspmd), "
+                        "explicit halo exchange for banded graphs (banded), "
+                        "or per-branch routing (auto)")
+    p.add_argument("--halo", type=int, default=None,
+                   help="halo budget for the banded region strategy "
+                        "(default: tightest, capped at shard_size/2 for auto)")
     p.add_argument("--matmul-precision", choices=("default", "high", "highest"),
                    default=None,
                    help="jax default matmul precision (TPU fp32 matmuls use "
@@ -143,6 +151,10 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.model.dtype = args.dtype
     if args.sparse:
         cfg.model.sparse = True
+    if args.region_strategy is not None:
+        cfg.mesh.region_strategy = args.region_strategy
+    if args.halo is not None:
+        cfg.mesh.halo = args.halo
     return cfg
 
 

@@ -118,6 +118,17 @@ class MeshConfig:
 
     dp: int = 1
     region: int = 1
+    #: how region-sharded graph convs communicate:
+    #: - "gspmd": dense supports, XLA's automatic plan (all-gathers the
+    #:   node axis of the signal per conv)
+    #: - "banded": explicit halo-exchange plan for every branch; raises if
+    #:   any support's bandwidth exceeds the shard size
+    #: - "auto": per-branch — banded where the supports are banded enough
+    #:   (bandwidth <= halo budget), GSPMD dense elsewhere
+    region_strategy: str = "gspmd"
+    #: halo budget for banded routing; None = tightest (max bandwidth),
+    #: capped by the auto-routing threshold n_local // 2
+    halo: Optional[int] = None
 
     @property
     def n_devices(self) -> int:
@@ -163,13 +174,19 @@ def _default() -> ExperimentConfig:
 
 
 def _scaled() -> ExperimentConfig:
-    """BASELINE config 3: 50x50 grid, K=3, region axis sharded."""
+    """BASELINE config 3: 50x50 grid, K=3, region axis sharded.
+
+    ``(dp=2, region=4)`` over 8 chips: N=2500 divides by 4 (625-node
+    shards), not by 8. ``region_strategy="auto"`` puts the banded grid
+    branch on the explicit halo plan (cheb-K3 bandwidth 150 << 625) and
+    the non-banded transport/similarity branches on GSPMD.
+    """
     return ExperimentConfig(
         name="scaled",
         data=DataConfig(rows=50, n_timesteps=24 * 7 * 4),
         model=ModelConfig(K=3, dtype="bfloat16"),
         train=TrainConfig(batch_size=16),
-        mesh=MeshConfig(region=8),
+        mesh=MeshConfig(dp=2, region=4, region_strategy="auto"),
     )
 
 

@@ -21,7 +21,14 @@ from stmgcn_tpu.data.splits import fraction_splits
 from stmgcn_tpu.models import STMGCN
 from stmgcn_tpu.train import Trainer
 
-__all__ = ["build_dataset", "build_supports", "build_model", "build_trainer", "run"]
+__all__ = [
+    "build_dataset",
+    "build_model",
+    "build_supports",
+    "build_trainer",
+    "route_supports",
+    "run",
+]
 
 
 def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
@@ -90,8 +97,75 @@ def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
     )
 
 
-def build_model(cfg: ExperimentConfig, input_dim: int) -> STMGCN:
-    """Model from config + the one data-derived scalar (feature count)."""
+def _strategy_active(cfg: ExperimentConfig) -> bool:
+    """Whether the mesh's region strategy replaces GSPMD's automatic plan."""
+    s = cfg.mesh.region_strategy
+    if s not in ("gspmd", "banded", "auto"):
+        raise ValueError(
+            f"mesh.region_strategy must be gspmd|banded|auto, got {s!r}"
+        )
+    return s != "gspmd" and cfg.mesh.region > 1 and not cfg.model.sparse
+
+
+def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None):
+    """Route each branch's supports per the mesh's region strategy.
+
+    Returns ``(supports, modes)`` where ``modes`` is ``None`` when GSPMD
+    (or sparse) handles everything, else a per-branch tuple of
+    ``"banded" | "dense"``: branches whose supports are banded enough
+    (max Chebyshev-support bandwidth within the halo budget, default
+    ``n_local // 2``) get strip form for the explicit halo-exchange plan;
+    the rest stay dense under GSPMD. ``region_strategy="banded"`` demands
+    every branch qualify and raises otherwise.
+    """
+    supports = build_supports(cfg, dataset) if supports is None else supports
+    if not _strategy_active(cfg):
+        return supports, None
+    import numpy as np
+
+    from stmgcn_tpu.parallel.banded import banded_decompose, bandwidth
+
+    region = cfg.mesh.region
+    n = dataset.n_nodes
+    if n % region:
+        raise ValueError(f"n_nodes {n} not divisible by region={region}")
+    n_local = n // region
+    budget = min(cfg.mesh.halo if cfg.mesh.halo is not None else n_local // 2, n_local)
+    routed, modes = [], []
+    for m in range(supports.shape[0]):
+        bw = max(bandwidth(supports[m, k]) for k in range(supports.shape[1]))
+        if bw <= budget:
+            routed.append(banded_decompose(np.asarray(supports[m]), region, halo=bw))
+            modes.append("banded")
+        elif cfg.mesh.region_strategy == "banded":
+            raise ValueError(
+                f"region_strategy='banded' but branch {m}'s supports have "
+                f"bandwidth {bw} > halo budget {budget} (shard size {n_local}) "
+                "— use 'auto' to keep non-banded branches on GSPMD, raise "
+                "mesh.halo, or reorder nodes to reduce bandwidth"
+            )
+        else:
+            routed.append(supports[m])
+            modes.append("dense")
+    return tuple(routed), tuple(modes)
+
+
+def build_model(
+    cfg: ExperimentConfig,
+    input_dim: int,
+    support_modes=None,
+    banded_spec=None,
+) -> STMGCN:
+    """Model from config + the one data-derived scalar (feature count).
+
+    ``support_modes``/``banded_spec`` come from :func:`route_supports` +
+    the live mesh. Whenever the config's region strategy is active the
+    branch parameters use the loop layout (``branch_0..branch_{M-1}``)
+    regardless of how many branches actually routed banded, so the
+    checkpoint layout is a function of the config alone — a
+    single-device rebuild (e.g. :class:`~stmgcn_tpu.inference.Forecaster`)
+    reconstructs the same layout with plain dense supports.
+    """
     m = cfg.model
     return STMGCN(
         m_graphs=m.m_graphs,
@@ -105,6 +179,9 @@ def build_model(cfg: ExperimentConfig, input_dim: int) -> STMGCN:
         use_bias=m.use_bias,
         shared_gate_fc=m.shared_gate_fc,
         sparse=m.sparse,
+        support_modes=support_modes,
+        banded_spec=banded_spec,
+        vmap_branches=not _strategy_active(cfg),
         remat=m.remat,
         dtype=m.compute_dtype if m.dtype != "float32" else None,
     )
@@ -132,8 +209,18 @@ def build_trainer(
 
         placement = MeshPlacement(mesh_from_config(cfg.mesh))
     dataset = build_dataset(cfg)
-    supports = build_supports(cfg, dataset)
-    model = build_model(cfg, dataset.n_feats)
+    supports, support_modes = route_supports(cfg, dataset)
+    banded_spec = None
+    if support_modes is not None and "banded" in support_modes:
+        from stmgcn_tpu.parallel.banded import BandedSpec
+
+        if placement is None or not hasattr(placement, "mesh"):
+            raise ValueError(
+                f"region_strategy={cfg.mesh.region_strategy!r} needs a mesh "
+                "placement (mesh.region > 1 with visible devices)"
+            )
+        banded_spec = BandedSpec(mesh=placement.mesh)
+    model = build_model(cfg, dataset.n_feats, support_modes, banded_spec)
     if placement is not None and hasattr(placement, "check_divisibility"):
         placement.check_divisibility(cfg.train.batch_size, dataset.n_nodes)
     t = cfg.train

@@ -27,7 +27,7 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from stmgcn_tpu.ops.chebconv import conv_cls
+from stmgcn_tpu.ops.chebconv import make_conv
 from stmgcn_tpu.ops.lstm import StackedLSTM
 
 __all__ = ["CGLSTM", "ContextualGate"]
@@ -41,7 +41,10 @@ class ContextualGate(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
-    sparse: bool = False
+    #: "dense" | "sparse" | "banded" — the support representation this
+    #: gate's graph conv consumes (see stmgcn_tpu.ops.chebconv.conv_cls)
+    support_mode: str = "dense"
+    banded_spec: Any = None
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -50,7 +53,9 @@ class ContextualGate(nn.Module):
         """``obs_seq`` ``(B, T, N, C)`` -> gated ``(B, T, N, C)``."""
         x_seq = obs_seq.sum(axis=-1)  # collapse features (STMGCN.py:36)
         x_nt = x_seq.transpose(0, 2, 1)  # (B, N, T): history as node features
-        g = conv_cls(self.sparse)(
+        g = make_conv(
+            self.support_mode,
+            banded_spec=self.banded_spec,
             n_supports=self.n_supports,
             features=self.seq_len,
             use_bias=self.use_bias,
@@ -87,7 +92,8 @@ class CGLSTM(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
-    sparse: bool = False
+    support_mode: str = "dense"
+    banded_spec: Any = None
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
@@ -101,7 +107,8 @@ class CGLSTM(nn.Module):
             use_bias=self.use_bias,
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
-            sparse=self.sparse,
+            support_mode=self.support_mode,
+            banded_spec=self.banded_spec,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="gate",

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from stmgcn_tpu.models.cg_lstm import CGLSTM
-from stmgcn_tpu.ops.chebconv import conv_cls
+from stmgcn_tpu.ops.chebconv import make_conv
 
 __all__ = ["STMGCN", "Branch"]
 
@@ -38,7 +38,10 @@ class Branch(nn.Module):
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
-    sparse: bool = False
+    #: support representation this branch consumes: "dense" | "sparse" |
+    #: "banded" (stmgcn_tpu.ops.chebconv.conv_cls)
+    support_mode: str = "dense"
+    banded_spec: Any = None
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
@@ -53,13 +56,16 @@ class Branch(nn.Module):
             use_bias=self.use_bias,
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
-            sparse=self.sparse,
+            support_mode=self.support_mode,
+            banded_spec=self.banded_spec,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="cg_lstm",
         )(supports, obs_seq)
-        return conv_cls(self.sparse)(
+        return make_conv(
+            self.support_mode,
+            banded_spec=self.banded_spec,
             n_supports=self.n_supports,
             features=self.gcn_hidden_dim,
             use_bias=self.use_bias,
@@ -94,12 +100,33 @@ class STMGCN(nn.Module):
     #: the graph axis); params live under branch_0..branch_{M-1} instead of
     #: a stacked axis
     sparse: bool = False
+    #: per-branch support representations, e.g. ``("banded", "dense",
+    #: "dense")`` — branches with banded (grid-structured) supports take
+    #: the explicit halo-exchange plan while the rest stay on GSPMD.
+    #: ``None`` derives a uniform tuple from ``sparse``. Any non-dense
+    #: entry forces the loop path (params under branch_0..branch_{M-1}).
+    support_modes: Optional[tuple] = None
+    #: static mesh/axis routing for branches in "banded" mode
+    banded_spec: Any = None
     vmap_branches: bool = True
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
-    def _branch_kwargs(self) -> dict:
+    def branch_modes(self) -> tuple:
+        """Effective per-branch support modes."""
+        if self.support_modes is not None:
+            if self.sparse:
+                raise ValueError("pass either sparse=True or support_modes, not both")
+            if len(self.support_modes) != self.m_graphs:
+                raise ValueError(
+                    f"support_modes needs {self.m_graphs} entries, "
+                    f"got {len(self.support_modes)}"
+                )
+            return tuple(self.support_modes)
+        return ("sparse" if self.sparse else "dense",) * self.m_graphs
+
+    def _branch_kwargs(self, mode: str = "dense") -> dict:
         return dict(
             n_supports=self.n_supports,
             seq_len=self.seq_len,
@@ -109,7 +136,8 @@ class STMGCN(nn.Module):
             use_bias=self.use_bias,
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
-            sparse=self.sparse,
+            support_mode=mode,
+            banded_spec=self.banded_spec if mode == "banded" else None,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -117,23 +145,28 @@ class STMGCN(nn.Module):
 
     @nn.compact
     def __call__(self, supports_stack, obs_seq: jnp.ndarray) -> jnp.ndarray:
-        """``supports_stack``: dense ``(M, K, N, N)`` array, or (sparse mode)
-        an M-sequence of K-sequences of ``BlockSparse``; ``obs_seq``
-        ``(B, T, N, C)``."""
-        if self.sparse:
+        """``supports_stack``: dense ``(M, K, N, N)`` array; or, when any
+        branch mode is non-dense, an M-sequence whose ``m``-th entry matches
+        branch ``m``'s mode — dense ``(K, N, N)`` array, K-sequence of
+        ``BlockSparse``, or ``BandedSupports``; ``obs_seq`` ``(B, T, N, C)``."""
+        modes = self.branch_modes()
+        all_dense = all(m == "dense" for m in modes)
+        if not all_dense:
             if len(supports_stack) != self.m_graphs:
                 raise ValueError(
-                    f"need {self.m_graphs} sparse support groups, "
+                    f"need {self.m_graphs} per-branch support groups, "
                     f"got {len(supports_stack)}"
                 )
-        elif supports_stack.ndim != 4 or supports_stack.shape[0] != self.m_graphs:
-            raise ValueError(
-                f"supports_stack must be ({self.m_graphs}, K, N, N), "
-                f"got {supports_stack.shape}"
-            )  # STMGCN.py:107
-        if self.sparse or not self.vmap_branches:
+        else:
+            supports_stack = jnp.asarray(supports_stack)  # accept an M-sequence
+            if supports_stack.ndim != 4 or supports_stack.shape[0] != self.m_graphs:
+                raise ValueError(
+                    f"supports_stack must be ({self.m_graphs}, K, N, N), "
+                    f"got {supports_stack.shape}"
+                )  # STMGCN.py:107
+        if not all_dense or not self.vmap_branches:
             feats = [
-                Branch(**self._branch_kwargs(), name=f"branch_{m}")(
+                Branch(**self._branch_kwargs(modes[m]), name=f"branch_{m}")(
                     supports_stack[m], obs_seq
                 )
                 for m in range(self.m_graphs)

@@ -21,13 +21,35 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["ChebGraphConv", "SparseChebGraphConv", "conv_cls"]
+__all__ = ["BandedChebGraphConv", "ChebGraphConv", "SparseChebGraphConv", "conv_cls", "make_conv"]
 
 
-def conv_cls(sparse: bool):
+def conv_cls(mode):
     """The graph-conv class for a support representation (one mapping, shared
-    by every call site that dispatches on sparse mode)."""
-    return SparseChebGraphConv if sparse else ChebGraphConv
+    by every call site that dispatches on support mode). ``mode`` is
+    ``"dense" | "sparse" | "banded"`` (bools accepted for back-compat:
+    ``True`` = sparse, ``False`` = dense)."""
+    if isinstance(mode, bool):
+        mode = "sparse" if mode else "dense"
+    classes = {
+        "dense": ChebGraphConv,
+        "sparse": SparseChebGraphConv,
+        "banded": BandedChebGraphConv,
+    }
+    if mode not in classes:
+        raise ValueError(f"support mode must be one of {sorted(classes)}, got {mode!r}")
+    return classes[mode]
+
+
+def make_conv(mode, banded_spec=None, **kwargs):
+    """Construct the graph conv for ``mode``; threads the static
+    :class:`~stmgcn_tpu.parallel.banded.BandedSpec` only where needed."""
+    cls = conv_cls(mode)
+    if cls is BandedChebGraphConv:
+        if banded_spec is None:
+            raise ValueError("banded support mode needs a BandedSpec (mesh + axis)")
+        kwargs["spec"] = banded_spec
+    return cls(**kwargs)
 
 
 def _conv_params(mod, f_in: int):
@@ -127,5 +149,53 @@ class SparseChebGraphConv(nn.Module):
             propagated.reshape(self.n_supports, n_nodes, batch, f_in)
             .transpose(2, 1, 0, 3)
             .reshape(batch, n_nodes, self.n_supports * f_in)
+        )
+        return _project(stacked, w, b, self.activation)
+
+
+class BandedChebGraphConv(nn.Module):
+    """Graph convolution over region-sharded banded support strips.
+
+    Same parameters and math as :class:`ChebGraphConv` (identical param
+    names/shapes — trained weights are interchangeable), but the K support
+    propagations run through the explicit halo-exchange plan
+    (:func:`stmgcn_tpu.parallel.banded.sharded_banded_apply`): each region
+    shard contracts only its strip of the supports and ``ppermute``s
+    ``halo`` boundary rows with its ring neighbors, instead of the
+    full-node all-gather GSPMD inserts for a dense region-sharded support
+    (the contraction the reference loops at ``GCN.py:34-36``).
+
+    Call with a :class:`~stmgcn_tpu.parallel.banded.BandedSupports` and a
+    signal ``x`` of shape ``(B, N, F_in)``; ``spec`` carries the mesh and
+    region-axis name (static).
+    """
+
+    n_supports: int
+    features: int
+    spec: Any = None  # BandedSpec (mesh + axis_name); static module attr
+    use_bias: bool = True
+    activation: Optional[Callable] = nn.relu
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, supports, x: jnp.ndarray) -> jnp.ndarray:
+        from stmgcn_tpu.parallel.banded import sharded_banded_apply
+
+        if supports.n_supports != self.n_supports:
+            raise ValueError(
+                f"expected {self.n_supports} supports, got {supports.n_supports}"
+            )
+        batch, n_nodes, f_in = x.shape
+        if n_nodes != supports.n:
+            raise ValueError(f"x has {n_nodes} nodes, strips expect {supports.n}")
+        w, b = _conv_params(self, f_in)
+        x, w, b = nn.dtypes.promote_dtype(x, w, b, dtype=self.dtype)
+        propagated = sharded_banded_apply(
+            self.spec.mesh, supports.strips, x, supports.halo, self.spec.axis_name
+        ).astype(x.dtype)  # strips are fp32; come back to the compute dtype
+        # (K, B, N, F) -> (B, N, K*F), k-major to match the dense layout
+        stacked = propagated.transpose(1, 2, 0, 3).reshape(
+            batch, n_nodes, self.n_supports * f_in
         )
         return _project(stacked, w, b, self.activation)

@@ -20,13 +20,23 @@ Multi-host: the same mesh axes extend over ``jax.distributed``-initialized
 process groups; collectives within a slice ride ICI and across slices DCN.
 """
 
-from stmgcn_tpu.parallel.banded import bandwidth, sharded_banded_apply, strip_decompose
+from stmgcn_tpu.parallel.banded import (
+    BandedSpec,
+    BandedSupports,
+    banded_decompose,
+    bandwidth,
+    sharded_banded_apply,
+    strip_decompose,
+)
 from stmgcn_tpu.parallel.halo import halo_exchange
 from stmgcn_tpu.parallel.mesh import build_mesh, init_distributed, mesh_from_config
 from stmgcn_tpu.parallel.placement import MeshPlacement
 
 __all__ = [
+    "BandedSpec",
+    "BandedSupports",
     "MeshPlacement",
+    "banded_decompose",
     "bandwidth",
     "build_mesh",
     "halo_exchange",

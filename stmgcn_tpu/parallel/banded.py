@@ -21,6 +21,9 @@ decomposition time).
 
 from __future__ import annotations
 
+import dataclasses
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
@@ -28,7 +31,69 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from stmgcn_tpu.parallel.halo import halo_exchange
 
-__all__ = ["bandwidth", "strip_decompose", "sharded_banded_apply"]
+__all__ = [
+    "BandedSpec",
+    "BandedSupports",
+    "bandwidth",
+    "banded_decompose",
+    "sharded_banded_apply",
+    "strip_decompose",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class BandedSpec:
+    """Static routing info for banded graph convs (flax module attribute):
+    which mesh to ``shard_map`` over and the name of its region axis."""
+
+    mesh: Mesh
+    axis_name: str = "region"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BandedSupports:
+    """One branch's supports in strip form: the banded analogue of the
+    dense ``(K, N, N)`` stack. ``strips`` is :func:`strip_decompose`
+    output ``(n_shards, K, n_local, n_local + 2*halo)``; ``halo`` and the
+    global node count ``n`` are static metadata."""
+
+    strips: jnp.ndarray
+    halo: int
+    n: int
+
+    def tree_flatten(self):
+        return (self.strips,), (self.halo, self.n)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (strips,) = children
+        halo, n = aux
+        return cls(strips=strips, halo=halo, n=n)
+
+    @property
+    def n_supports(self) -> int:
+        return self.strips.shape[1]
+
+    @property
+    def n_shards(self) -> int:
+        return self.strips.shape[0]
+
+
+def banded_decompose(supports, n_shards: int, halo: int | None = None) -> BandedSupports:
+    """``(K, N, N)`` dense supports -> :class:`BandedSupports`.
+
+    ``halo=None`` uses the tightest halo: the max bandwidth over the K
+    supports (still subject to the ``halo <= n_local`` strip limit).
+    """
+    supports = np.asarray(supports, dtype=np.float32)
+    if halo is None:
+        halo = max(bandwidth(supports[k]) for k in range(supports.shape[0]))
+    return BandedSupports(
+        strips=jnp.asarray(strip_decompose(supports, n_shards, halo)),
+        halo=halo,
+        n=supports.shape[1],
+    )
 
 
 def bandwidth(mat) -> int:
@@ -73,20 +138,32 @@ def strip_decompose(supports, n_shards: int, halo: int) -> np.ndarray:
 
 
 def sharded_banded_apply(
-    mesh: Mesh, strips, x, halo: int, axis_name: str = "region"
+    mesh: Mesh,
+    strips,
+    x,
+    halo: int,
+    axis_name: str = "region",
+    batch_axis: str = "dp",
 ) -> jnp.ndarray:
     """``out[k,b,i,f] = sum_j A_k[i,j] x[b,j,f]`` with the node axis sharded.
 
     ``strips``: :func:`strip_decompose` output; ``x``: ``(B, N, F)``.
     Returns ``(K, B, N, F)`` with ``N`` sharded over ``axis_name``; each
     shard exchanges only ``halo`` boundary rows.
+
+    When the mesh also has a ``batch_axis`` (data parallelism), ``x``'s
+    batch dimension stays partitioned over it inside the ``shard_map`` —
+    otherwise SPMD would replicate the activations across dp at the manual
+    boundary (an involuntary full rematerialization) just to run a
+    computation that is elementwise-parallel over batch anyway.
     """
+    b_ax = batch_axis if batch_axis in mesh.shape and mesh.shape[batch_axis] > 1 else None
 
     def local(strip, x_loc):
-        # strip: (1, K, nl, nl+2h) — leading shard axis; x_loc: (B, nl, F)
+        # strip: (1, K, nl, nl+2h) — leading shard axis; x_loc: (b_loc, nl, F)
         if halo > 0:
             xp = x_loc.swapaxes(0, 1)
-            xp = halo_exchange(xp, halo, axis_name)  # (nl+2h, B, F)
+            xp = halo_exchange(xp, halo, axis_name)  # (nl+2h, b_loc, F)
         else:  # diagonal-only supports: nothing to exchange
             xp = x_loc.swapaxes(0, 1)
         # contract local rows against the padded neighborhood
@@ -95,7 +172,7 @@ def sharded_banded_apply(
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis_name, None, None, None), P(None, axis_name, None)),
-        out_specs=P(None, None, axis_name, None),
+        in_specs=(P(axis_name, None, None, None), P(b_ax, axis_name, None)),
+        out_specs=P(None, b_ax, axis_name, None),
     )
     return fn(jnp.asarray(strips), jnp.asarray(x))

@@ -59,15 +59,44 @@ class MeshPlacement:
 
         Batch axes must divide the mesh extents they shard over (use
         ``pad_last`` batching for static, divisible batch shapes).
+        ``kind="supports"`` additionally understands the routed per-branch
+        forms (see :func:`stmgcn_tpu.experiment.route_supports`).
         """
         if kind not in self.SPECS:
             raise ValueError(f"unknown array kind {kind!r}; known: {sorted(self.SPECS)}")
+        if kind == "supports":
+            return self._put_supports(tree)
         return jax.tree.map(
             lambda a: jax.device_put(
                 jnp.asarray(a), self.sharding(kind, jnp.ndim(a))
             ),
             tree,
         )
+
+    def _put_supports(self, supports):
+        """Dense ``(M, K, N, N)`` stack, per-branch ``(K, N, N)`` arrays,
+        or :class:`~stmgcn_tpu.parallel.banded.BandedSupports` strips
+        (leading shard axis over region)."""
+        from stmgcn_tpu.parallel.banded import BandedSupports
+
+        if isinstance(supports, (tuple, list)):
+            return tuple(self._put_supports(s) for s in supports)
+        if isinstance(supports, BandedSupports):
+            strips = jax.device_put(
+                jnp.asarray(supports.strips),
+                NamedSharding(self.mesh, P("region", None, None, None)),
+            )
+            return BandedSupports(strips=strips, halo=supports.halo, n=supports.n)
+        arr = jnp.asarray(supports)
+        if arr.ndim == 4:  # (M, K, N, N): output-node rows sharded
+            spec = self.SPECS["supports"]
+        elif arr.ndim == 3:  # per-branch (K, N, N)
+            spec = P(None, "region", None)
+        else:
+            raise ValueError(
+                f"supports must be (M, K, N, N) or (K, N, N), got shape {arr.shape}"
+            )
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
     def check_divisibility(self, batch_size: int, n_nodes: int) -> None:
         dp = self.mesh.shape["dp"]

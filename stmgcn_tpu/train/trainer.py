@@ -45,6 +45,16 @@ from stmgcn_tpu.train.step import make_optimizer, make_step_fns
 __all__ = ["Trainer"]
 
 
+def _contains_blocksparse(supports) -> bool:
+    from stmgcn_tpu.ops.spmm import BlockSparse
+
+    if isinstance(supports, BlockSparse):
+        return True
+    if isinstance(supports, (tuple, list)):
+        return any(_contains_blocksparse(s) for s in supports)
+    return False
+
+
 class _DefaultPlacement:
     """Single-device placement: plain ``jnp.asarray``; state left in place."""
 
@@ -90,14 +100,13 @@ class Trainer:
         # device placement hook; stmgcn_tpu.parallel.MeshPlacement shards over
         # a mesh, the default puts everything on the default device
         self.placement = placement or _DefaultPlacement()
-        # supports: dense (M, K, N, N) array or a BlockSparse pytree
-        if not isinstance(supports, (np.ndarray, jnp.ndarray)) and hasattr(
-            self.placement, "mesh"
-        ):
+        # supports: dense (M, K, N, N) array, a routed per-branch tuple
+        # (dense / BandedSupports), or a BlockSparse pytree
+        if _contains_blocksparse(supports) and hasattr(self.placement, "mesh"):
             # guard at the seam the config-level check cannot see (explicit
             # placement / direct Trainer construction)
             raise ValueError(
-                "sparse (pytree) supports cannot be mesh-sharded yet — "
+                "sparse (BlockSparse) supports cannot be mesh-sharded yet — "
                 "pass dense supports or a single-device placement"
             )
         self.supports = self.placement.put(supports, "supports")

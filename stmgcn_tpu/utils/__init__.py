@@ -1,15 +1,18 @@
 """Utilities: profiling/timing, FLOPs/MFU accounting, numeric debugging."""
 
+from stmgcn_tpu.utils.comm import collective_stats, step_comm_report
 from stmgcn_tpu.utils.flops import device_peak_flops, mfu, stmgcn_step_flops
 from stmgcn_tpu.utils.platform import force_host_platform
 from stmgcn_tpu.utils.profiling import StepTimer, region_timesteps_per_sec, trace
 
 __all__ = [
     "StepTimer",
+    "collective_stats",
     "device_peak_flops",
     "force_host_platform",
     "mfu",
     "region_timesteps_per_sec",
+    "step_comm_report",
     "stmgcn_step_flops",
     "trace",
 ]

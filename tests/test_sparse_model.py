@@ -90,7 +90,7 @@ class TestSparseModel:
     def test_wrong_group_count_raises(self):
         dense, sparse, x = setup()
         model = STMGCN(**model_kw(3, sparse=True))
-        with pytest.raises(ValueError, match="sparse support groups"):
+        with pytest.raises(ValueError, match="support groups"):
             model.init(jax.random.key(0), sparse, x)
 
 

@@ -83,18 +83,15 @@ def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
     """Supports from the dataset's graphs.
 
     Dense mode: one stacked ``(M, n_supports, N, N)`` array. Sparse mode:
-    an M-tuple of K-tuples of :class:`~stmgcn_tpu.ops.spmm.BlockSparse`
-    for the Pallas SpMM path.
+    an M-tuple of :class:`~stmgcn_tpu.ops.spmm.BlockSparseStack` — each
+    branch's K supports in one fused-launch block-CSR structure.
     """
     dense = cfg.model.support_config.build_all(dataset.adjs.values())
     if not cfg.model.sparse:
         return dense
-    from stmgcn_tpu.ops.spmm import from_dense
+    from stmgcn_tpu.ops.spmm import stack_from_dense
 
-    return tuple(
-        tuple(from_dense(dense[m, k]) for k in range(dense.shape[1]))
-        for m in range(dense.shape[0])
-    )
+    return tuple(stack_from_dense(dense[m]) for m in range(dense.shape[0]))
 
 
 def _strategy_active(cfg: ExperimentConfig) -> bool:
@@ -111,13 +108,27 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
     """Route each branch's supports per the mesh's region strategy.
 
     Returns ``(supports, modes)`` where ``modes`` is ``None`` when GSPMD
-    (or sparse) handles everything, else a per-branch tuple of
-    ``"banded" | "dense"``: branches whose supports are banded enough
-    (max Chebyshev-support bandwidth within the halo budget, default
-    ``n_local // 2``) get strip form for the explicit halo-exchange plan;
-    the rest stay dense under GSPMD. ``region_strategy="banded"`` demands
-    every branch qualify and raises otherwise.
+    (or single-device sparse) handles everything, else a per-branch tuple:
+
+    - dense + active region strategy: ``"banded" | "dense"`` per branch —
+      branches whose supports are banded enough (max Chebyshev-support
+      bandwidth within the halo budget, default ``n_local // 2``) get
+      strip form for the explicit halo-exchange plan; the rest stay dense
+      under GSPMD. ``region_strategy="banded"`` demands every branch
+      qualify and raises otherwise.
+    - sparse on a >1-device mesh: ``("sparse",) * M`` with each branch's
+      supports as :class:`~stmgcn_tpu.parallel.sparse.ShardedBlockSparse`
+      row strips over the region axis.
     """
+    if cfg.model.sparse and cfg.mesh.n_devices > 1:
+        from stmgcn_tpu.parallel.sparse import sharded_from_dense
+
+        dense = cfg.model.support_config.build_all(dataset.adjs.values())
+        routed = tuple(
+            sharded_from_dense(dense[m], cfg.mesh.region)
+            for m in range(dense.shape[0])
+        )
+        return routed, ("sparse",) * dense.shape[0]
     supports = build_supports(cfg, dataset) if supports is None else supports
     if not _strategy_active(cfg):
         return supports, None
@@ -154,17 +165,18 @@ def build_model(
     cfg: ExperimentConfig,
     input_dim: int,
     support_modes=None,
-    banded_spec=None,
+    shard_spec=None,
 ) -> STMGCN:
     """Model from config + the one data-derived scalar (feature count).
 
-    ``support_modes``/``banded_spec`` come from :func:`route_supports` +
+    ``support_modes``/``shard_spec`` come from :func:`route_supports` +
     the live mesh. Whenever the config's region strategy is active the
     branch parameters use the loop layout (``branch_0..branch_{M-1}``)
     regardless of how many branches actually routed banded, so the
     checkpoint layout is a function of the config alone — a
     single-device rebuild (e.g. :class:`~stmgcn_tpu.inference.Forecaster`)
-    reconstructs the same layout with plain dense supports.
+    reconstructs the same layout with plain dense supports. (Sparse mode
+    always uses the loop layout, sharded or not.)
     """
     m = cfg.model
     return STMGCN(
@@ -178,9 +190,11 @@ def build_model(
         gcn_hidden_dim=m.gcn_hidden_dim,
         use_bias=m.use_bias,
         shared_gate_fc=m.shared_gate_fc,
-        sparse=m.sparse,
+        # support_modes carries the routing when set (e.g. sharded sparse);
+        # sparse=True alongside it would be rejected by the model
+        sparse=m.sparse and support_modes is None,
         support_modes=support_modes,
-        banded_spec=banded_spec,
+        shard_spec=shard_spec,
         vmap_branches=not _strategy_active(cfg),
         remat=m.remat,
         dtype=m.compute_dtype if m.dtype != "float32" else None,
@@ -198,11 +212,6 @@ def build_trainer(
     raises — silent fallback to one device would misreport the benchmark
     configs (3/4) as sharded.
     """
-    if placement is None and cfg.model.sparse and cfg.mesh.n_devices > 1:
-        raise ValueError(
-            "sparse mode does not support mesh sharding yet — use dense "
-            "supports for multi-device configs"
-        )
     if placement is None and cfg.mesh.n_devices > 1:
         # Fail fast (before data/support construction) if the mesh can't exist.
         from stmgcn_tpu.parallel import MeshPlacement, mesh_from_config
@@ -210,17 +219,17 @@ def build_trainer(
         placement = MeshPlacement(mesh_from_config(cfg.mesh))
     dataset = build_dataset(cfg)
     supports, support_modes = route_supports(cfg, dataset)
-    banded_spec = None
-    if support_modes is not None and "banded" in support_modes:
-        from stmgcn_tpu.parallel.banded import BandedSpec
+    shard_spec = None
+    if support_modes is not None and {"banded", "sparse"} & set(support_modes):
+        from stmgcn_tpu.parallel.banded import ShardSpec
 
         if placement is None or not hasattr(placement, "mesh"):
             raise ValueError(
-                f"region_strategy={cfg.mesh.region_strategy!r} needs a mesh "
-                "placement (mesh.region > 1 with visible devices)"
+                "mesh-routed supports (banded/sharded-sparse) need a mesh "
+                "placement (mesh.n_devices > 1 with visible devices)"
             )
-        banded_spec = BandedSpec(mesh=placement.mesh)
-    model = build_model(cfg, dataset.n_feats, support_modes, banded_spec)
+        shard_spec = ShardSpec(mesh=placement.mesh)
+    model = build_model(cfg, dataset.n_feats, support_modes, shard_spec)
     if placement is not None and hasattr(placement, "check_divisibility"):
         placement.check_divisibility(cfg.train.batch_size, dataset.n_nodes)
     t = cfg.train

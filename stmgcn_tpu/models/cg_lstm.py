@@ -44,7 +44,7 @@ class ContextualGate(nn.Module):
     #: "dense" | "sparse" | "banded" — the support representation this
     #: gate's graph conv consumes (see stmgcn_tpu.ops.chebconv.conv_cls)
     support_mode: str = "dense"
-    banded_spec: Any = None
+    shard_spec: Any = None
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -55,7 +55,7 @@ class ContextualGate(nn.Module):
         x_nt = x_seq.transpose(0, 2, 1)  # (B, N, T): history as node features
         g = make_conv(
             self.support_mode,
-            banded_spec=self.banded_spec,
+            shard_spec=self.shard_spec,
             n_supports=self.n_supports,
             features=self.seq_len,
             use_bias=self.use_bias,
@@ -93,7 +93,7 @@ class CGLSTM(nn.Module):
     activation: Optional[Callable] = nn.relu
     shared_gate_fc: bool = True
     support_mode: str = "dense"
-    banded_spec: Any = None
+    shard_spec: Any = None
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
@@ -108,7 +108,7 @@ class CGLSTM(nn.Module):
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
             support_mode=self.support_mode,
-            banded_spec=self.banded_spec,
+            shard_spec=self.shard_spec,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="gate",

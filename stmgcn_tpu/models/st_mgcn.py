@@ -41,7 +41,7 @@ class Branch(nn.Module):
     #: support representation this branch consumes: "dense" | "sparse" |
     #: "banded" (stmgcn_tpu.ops.chebconv.conv_cls)
     support_mode: str = "dense"
-    banded_spec: Any = None
+    shard_spec: Any = None
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
@@ -57,7 +57,7 @@ class Branch(nn.Module):
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
             support_mode=self.support_mode,
-            banded_spec=self.banded_spec,
+            shard_spec=self.shard_spec,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -65,7 +65,7 @@ class Branch(nn.Module):
         )(supports, obs_seq)
         return make_conv(
             self.support_mode,
-            banded_spec=self.banded_spec,
+            shard_spec=self.shard_spec,
             n_supports=self.n_supports,
             features=self.gcn_hidden_dim,
             use_bias=self.use_bias,
@@ -106,8 +106,9 @@ class STMGCN(nn.Module):
     #: ``None`` derives a uniform tuple from ``sparse``. Any non-dense
     #: entry forces the loop path (params under branch_0..branch_{M-1}).
     support_modes: Optional[tuple] = None
-    #: static mesh/axis routing for branches in "banded" mode
-    banded_spec: Any = None
+    #: static mesh/axis routing for "banded" branches and mesh-sharded
+    #: "sparse" branches
+    shard_spec: Any = None
     vmap_branches: bool = True
     remat: bool = False
     dtype: Optional[Any] = None
@@ -137,7 +138,7 @@ class STMGCN(nn.Module):
             activation=self.activation,
             shared_gate_fc=self.shared_gate_fc,
             support_mode=mode,
-            banded_spec=self.banded_spec if mode == "banded" else None,
+            shard_spec=self.shard_spec if mode in ("banded", "sparse") else None,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["available", "window_gather", "nonzero_block_scan"]
+__all__ = ["available", "window_gather", "nonzero_block_scan", "nonzero_block_scan_rect"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libstmgcn_native.so")
@@ -53,6 +53,11 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_ubyte),
         ]
         lib.nonzero_block_scan.restype = None
+        lib.nonzero_block_scan_rect.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_ubyte),
+        ]
+        lib.nonzero_block_scan_rect.restype = None
         _lib = lib
     except (OSError, subprocess.SubprocessError):
         _lib = None
@@ -92,15 +97,21 @@ def window_gather(data: np.ndarray, offsets: np.ndarray, burn_in: int):
 
 def nonzero_block_scan(padded: np.ndarray, tile: int):
     """Native ``(R, R)`` bool nonzero-block map; ``None`` when unavailable."""
+    return nonzero_block_scan_rect(padded, tile)
+
+
+def nonzero_block_scan_rect(padded: np.ndarray, tile: int):
+    """Native ``(Rr, Rc)`` bool nonzero-block map of a rectangular padded
+    matrix; ``None`` when unavailable."""
     lib = _load()
     if lib is None:
         return None
     padded = np.ascontiguousarray(padded, dtype=np.float32)
-    n_pad = padded.shape[0]
-    r = n_pad // tile
-    nz = np.zeros((r, r), dtype=np.uint8)
-    lib.nonzero_block_scan(
-        _fptr(padded), n_pad, tile,
+    nr_pad, nc_pad = padded.shape
+    rr, rc = nr_pad // tile, nc_pad // tile
+    nz = np.zeros((rr, rc), dtype=np.uint8)
+    lib.nonzero_block_scan_rect(
+        _fptr(padded), nr_pad, nc_pad, tile,
         nz.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
     )
     return nz.astype(bool)

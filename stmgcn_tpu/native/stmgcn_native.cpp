@@ -46,15 +46,17 @@ void window_gather(const float* data, int64_t T, int64_t N, int64_t C,
   }
 }
 
-// mat: (n_pad, n_pad) float32, n_pad % tile == 0. nz: (R, R) uint8 output
-// (R = n_pad / tile), set to 1 where the block holds any nonzero.
-void nonzero_block_scan(const float* mat, int64_t n_pad, int64_t tile,
-                        unsigned char* nz) {
-  const int64_t R = n_pad / tile;
-  for (int64_t i = 0; i < n_pad; ++i) {
-    const float* row = mat + static_cast<size_t>(i) * n_pad;
-    unsigned char* nzrow = nz + (i / tile) * R;
-    for (int64_t j = 0; j < n_pad; ++j) {
+// mat: (nr_pad, nc_pad) float32, both dims % tile == 0. nz: (Rr, Rc) uint8
+// output (Rr = nr_pad / tile, Rc = nc_pad / tile), set to 1 where the block
+// holds any nonzero. Rectangular form: row strips of region-sharded
+// supports are (n_local, N).
+void nonzero_block_scan_rect(const float* mat, int64_t nr_pad, int64_t nc_pad,
+                             int64_t tile, unsigned char* nz) {
+  const int64_t Rc = nc_pad / tile;
+  for (int64_t i = 0; i < nr_pad; ++i) {
+    const float* row = mat + static_cast<size_t>(i) * nc_pad;
+    unsigned char* nzrow = nz + (i / tile) * Rc;
+    for (int64_t j = 0; j < nc_pad; ++j) {
       if (row[j] != 0.0f) {
         nzrow[j / tile] = 1;
         // skip to the next block boundary: everything until there maps to
@@ -63,6 +65,12 @@ void nonzero_block_scan(const float* mat, int64_t n_pad, int64_t tile,
       }
     }
   }
+}
+
+// Square back-compat wrapper (the original ABI).
+void nonzero_block_scan(const float* mat, int64_t n_pad, int64_t tile,
+                        unsigned char* nz) {
+  nonzero_block_scan_rect(mat, n_pad, n_pad, tile, nz);
 }
 
 }  // extern "C"

@@ -41,14 +41,18 @@ def conv_cls(mode):
     return classes[mode]
 
 
-def make_conv(mode, banded_spec=None, **kwargs):
+def make_conv(mode, shard_spec=None, **kwargs):
     """Construct the graph conv for ``mode``; threads the static
-    :class:`~stmgcn_tpu.parallel.banded.BandedSpec` only where needed."""
+    :class:`~stmgcn_tpu.parallel.banded.ShardSpec` only where needed
+    (required for banded, optional for sparse — only its mesh-sharded
+    support form uses it)."""
     cls = conv_cls(mode)
     if cls is BandedChebGraphConv:
-        if banded_spec is None:
-            raise ValueError("banded support mode needs a BandedSpec (mesh + axis)")
-        kwargs["spec"] = banded_spec
+        if shard_spec is None:
+            raise ValueError("banded support mode needs a ShardSpec (mesh + axis)")
+        kwargs["spec"] = shard_spec
+    elif cls is SparseChebGraphConv:
+        kwargs["spec"] = shard_spec
     return cls(**kwargs)
 
 
@@ -113,15 +117,24 @@ class SparseChebGraphConv(nn.Module):
 
     Same parameters and math as :class:`ChebGraphConv` (identical param
     names/shapes, so trained weights are interchangeable), but the K
-    support propagations run through the block-CSR Pallas kernel in
+    support propagations run through the block-CSR Pallas kernels in
     :mod:`stmgcn_tpu.ops.spmm` instead of a dense einsum — the memory/FLOP
     win for the large-N configs where dense ``(K, N, N)`` supports are
-    mostly zeros. Call with a K-tuple of :class:`~stmgcn_tpu.ops.spmm.
-    BlockSparse` supports built offline via ``spmm.from_dense``.
+    mostly zeros.
+
+    Accepted support forms:
+
+    - :class:`~stmgcn_tpu.ops.spmm.BlockSparseStack` — all K propagations
+      in ONE fused kernel launch (preferred single-device path);
+    - :class:`~stmgcn_tpu.parallel.sparse.ShardedBlockSparse` — per-shard
+      row strips over a region mesh (requires ``spec``: the mesh/axis);
+    - a K-tuple of :class:`~stmgcn_tpu.ops.spmm.BlockSparse` — legacy
+      one-launch-per-support loop.
     """
 
     n_supports: int
     features: int
+    spec: Any = None  # ShardSpec; only needed for ShardedBlockSparse supports
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     dtype: Optional[Any] = None
@@ -129,21 +142,42 @@ class SparseChebGraphConv(nn.Module):
 
     @nn.compact
     def __call__(self, supports, x: jnp.ndarray) -> jnp.ndarray:
-        from stmgcn_tpu.ops.spmm import spmm
+        from stmgcn_tpu.ops.spmm import BlockSparseStack, spmm, spmm_stack
+        from stmgcn_tpu.parallel.sparse import ShardedBlockSparse, sharded_spmm_apply
 
-        if len(supports) != self.n_supports:
-            raise ValueError(
-                f"expected {self.n_supports} supports, got {len(supports)}"
-            )
+        k = (
+            supports.n_supports
+            if isinstance(supports, (BlockSparseStack, ShardedBlockSparse))
+            else len(supports)
+        )
+        if k != self.n_supports:
+            raise ValueError(f"expected {self.n_supports} supports, got {k}")
         batch, n_nodes, f_in = x.shape
         w, b = _conv_params(self, f_in)
         x, w, b = nn.dtypes.promote_dtype(x, w, b, dtype=self.dtype)
-        # (B, N, F) -> (N, B*F): one SpMM per support over all batch/features
+
+        if isinstance(supports, ShardedBlockSparse):
+            if self.spec is None:
+                raise ValueError(
+                    "ShardedBlockSparse supports need a ShardSpec (mesh + axis)"
+                )
+            propagated = sharded_spmm_apply(
+                self.spec.mesh, supports, x, self.spec.axis_name
+            ).astype(x.dtype)  # (K, B, N, F)
+            stacked = propagated.transpose(1, 2, 0, 3).reshape(
+                batch, n_nodes, self.n_supports * f_in
+            )
+            return _project(stacked, w, b, self.activation)
+
+        # (B, N, F) -> (N, B*F): propagate all batch/features per support
         x_mat = x.transpose(1, 0, 2).reshape(n_nodes, batch * f_in)
-        # kernel accumulates fp32; cast back to the compute dtype
-        propagated = jnp.stack(
-            [spmm(bs, x_mat).astype(x.dtype) for bs in supports], axis=0
-        )
+        if isinstance(supports, BlockSparseStack):
+            propagated = spmm_stack(supports, x_mat).astype(x.dtype)  # one launch
+        else:
+            # kernel accumulates fp32; cast back to the compute dtype
+            propagated = jnp.stack(
+                [spmm(bs, x_mat).astype(x.dtype) for bs in supports], axis=0
+            )
         # (K, N, B*F) -> (B, N, K*F), k-major to match the dense layout
         stacked = (
             propagated.reshape(self.n_supports, n_nodes, batch, f_in)
@@ -172,7 +206,7 @@ class BandedChebGraphConv(nn.Module):
 
     n_supports: int
     features: int
-    spec: Any = None  # BandedSpec (mesh + axis_name); static module attr
+    spec: Any = None  # ShardSpec (mesh + axis_name); static module attr
     use_bias: bool = True
     activation: Optional[Callable] = nn.relu
     dtype: Optional[Any] = None

@@ -43,7 +43,15 @@ try:  # pltpu is importable off-TPU too; guard anyway for exotic builds
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["BlockSparse", "from_dense", "spmm", "spmm_dense_reference"]
+__all__ = [
+    "BlockSparse",
+    "BlockSparseStack",
+    "from_dense",
+    "spmm",
+    "spmm_dense_reference",
+    "spmm_stack",
+    "stack_from_dense",
+]
 
 TILE = 128
 
@@ -92,26 +100,9 @@ class BlockSparse:
 
 
 def _to_blocks(mat: np.ndarray, tile: int):
-    """Dense (N, N) -> uniform block-CSR (data, idx) numpy arrays."""
-    n_pad = _ceil_to(mat.shape[0], tile)
-    padded = np.zeros((n_pad, n_pad), dtype=np.float32)
-    padded[: mat.shape[0], : mat.shape[1]] = mat
-    r = n_pad // tile
-    blocks = padded.reshape(r, tile, r, tile).transpose(0, 2, 1, 3)
-    from stmgcn_tpu import native
-
-    nonzero = native.nonzero_block_scan(padded, tile)  # (R, R); None w/o lib
-    if nonzero is None:
-        nonzero = np.any(blocks != 0.0, axis=(2, 3))
-    c_max = max(int(nonzero.sum(axis=1).max()), 1)
-    data = np.zeros((r, c_max, tile, tile), dtype=np.float32)
-    idx = np.zeros((r, c_max), dtype=np.int32)
-    for i in range(r):
-        cols = np.flatnonzero(nonzero[i])
-        data[i, : len(cols)] = blocks[i, cols]
-        idx[i, : len(cols)] = cols
-        # padding entries keep idx 0 with zero data: harmless accumulation
-    return data, idx
+    """Dense (N, N) -> uniform block-CSR (data, idx) numpy arrays.
+    Padding entries keep idx 0 with zero data: harmless accumulation."""
+    return _to_blocks_rect(mat, tile)
 
 
 def from_dense(mat, tile: int = TILE) -> BlockSparse:
@@ -214,3 +205,248 @@ def spmm(bs: BlockSparse, x: jnp.ndarray, interpret: Optional[bool] = None) -> j
 def spmm_dense_reference(mat, x) -> jnp.ndarray:
     """Dense einsum equivalent, for cross-checking the kernel."""
     return jnp.asarray(mat) @ jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# Fused K-support stack: all K propagations of one branch in ONE Pallas
+# launch (the single-support path above launches K kernels from a Python
+# loop — K dispatches plus a stack where the dense path is one einsum).
+# Rectangular (n_rows, n_cols) structure is supported so a region shard's
+# row strip of the supports works through the same kernel.
+# ---------------------------------------------------------------------------
+
+
+def _scan_blocks(mat: np.ndarray, tile: int):
+    """Dense (Nr, Nc) -> padded (R, C, tile, tile) block view + (R, C)
+    nonzero map (native fast-path scan, numpy fallback)."""
+    from stmgcn_tpu import native
+
+    nr, nc = mat.shape
+    r, c = _ceil_to(nr, tile) // tile, _ceil_to(nc, tile) // tile
+    padded = np.zeros((r * tile, c * tile), dtype=np.float32)
+    padded[:nr, :nc] = mat
+    blocks = padded.reshape(r, tile, c, tile).transpose(0, 2, 1, 3)
+    nonzero = native.nonzero_block_scan_rect(padded, tile)
+    if nonzero is None:
+        nonzero = np.any(blocks != 0.0, axis=(2, 3))
+    return blocks, nonzero
+
+
+def _assemble_blocks(blocks, nonzero, c_max: int, tile: int):
+    """Scanned blocks -> uniform block-CSR (data, idx) at an imposed width."""
+    r = blocks.shape[0]
+    need = max(int(nonzero.sum(axis=1).max()), 1)
+    if need > c_max:
+        raise ValueError(f"row needs {need} block-columns > imposed c_max {c_max}")
+    data = np.zeros((r, c_max, tile, tile), dtype=np.float32)
+    idx = np.zeros((r, c_max), dtype=np.int32)
+    for i in range(r):
+        cols = np.flatnonzero(nonzero[i])
+        data[i, : len(cols)] = blocks[i, cols]
+        idx[i, : len(cols)] = cols
+    return data, idx
+
+
+def _to_blocks_rect(mat: np.ndarray, tile: int, c_max: Optional[int] = None):
+    """Dense (Nr, Nc) -> uniform block-CSR (data, idx); optionally padded to
+    an externally-imposed ``c_max`` (for uniform stacking)."""
+    blocks, nonzero = _scan_blocks(mat, tile)
+    if c_max is None:
+        c_max = max(int(nonzero.sum(axis=1).max()), 1)
+    return _assemble_blocks(blocks, nonzero, c_max, tile)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BlockSparseStack:
+    """K same-shape supports in uniform block-CSR, plus transposes.
+
+    ``data`` ``(K, R, C, tile, tile)``, ``idx`` ``(K, R, C)``; the
+    transpose structure mirrors it for the backward pass. ``n_rows`` /
+    ``n_cols`` are the original (unpadded) dimensions.
+    """
+
+    data: jnp.ndarray
+    idx: jnp.ndarray
+    data_t: jnp.ndarray
+    idx_t: jnp.ndarray
+    n_rows: int
+    n_cols: int
+    tile: int
+
+    def tree_flatten(self):
+        return (self.data, self.idx, self.data_t, self.idx_t), (
+            self.n_rows,
+            self.n_cols,
+            self.tile,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, idx, data_t, idx_t = children
+        n_rows, n_cols, tile = aux
+        return cls(data=data, idx=idx, data_t=data_t, idx_t=idx_t,
+                   n_rows=n_rows, n_cols=n_cols, tile=tile)
+
+    @property
+    def n_supports(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def density(self) -> float:
+        return self.data.shape[2] / self.data_t.shape[1]
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.idx.nbytes + self.data_t.nbytes + self.idx_t.nbytes
+
+
+def stack_from_dense(mats, tile: int = TILE) -> BlockSparseStack:
+    """Build a :class:`BlockSparseStack` from dense ``(K, Nr, Nc)`` supports.
+
+    One ``c_max`` across the K supports (max row occupancy) keeps every
+    kernel operand shape static.
+    """
+    mats = np.asarray(mats, dtype=np.float32)
+    if mats.ndim != 3:
+        raise ValueError(f"supports must be (K, Nr, Nc), got {mats.shape}")
+    k = mats.shape[0]
+    # one scan per support; c_max from the nonzero maps, assembly once
+    fwd_scan = [_scan_blocks(mats[i], tile) for i in range(k)]
+    bwd_scan = [_scan_blocks(np.ascontiguousarray(mats[i].T), tile) for i in range(k)]
+    c_max = max(max(int(nz.sum(axis=1).max()), 1) for _, nz in fwd_scan)
+    c_max_t = max(max(int(nz.sum(axis=1).max()), 1) for _, nz in bwd_scan)
+    fwd = [_assemble_blocks(b, nz, c_max, tile) for b, nz in fwd_scan]
+    bwd = [_assemble_blocks(b, nz, c_max_t, tile) for b, nz in bwd_scan]
+    return BlockSparseStack(
+        data=jnp.asarray(np.stack([d for d, _ in fwd])),
+        idx=jnp.asarray(np.stack([i for _, i in fwd])),
+        data_t=jnp.asarray(np.stack([d for d, _ in bwd])),
+        idx_t=jnp.asarray(np.stack([i for _, i in bwd])),
+        n_rows=mats.shape[1],
+        n_cols=mats.shape[2],
+        tile=tile,
+    )
+
+
+def _stack_fwd_kernel(idx_ref, data_ref, x_ref, out_ref):
+    c = pl.program_id(3)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jnp.dot(
+        data_ref[0, 0, 0], x_ref[0], preferred_element_type=jnp.float32
+    )
+
+
+def _stack_fwd_call(data, idx, x, n_rows, n_cols, tile, interpret):
+    """One launch: ``out[k] = A_k @ x`` for all K supports. ``x``: (Nc, M)."""
+    k, r, c_max = idx.shape
+    m = x.shape[1]
+    tm = min(256, _ceil_to(m, TILE))
+    m_pad = _ceil_to(m, tm)
+    x_pad = jnp.zeros((_ceil_to(n_cols, tile), m_pad), x.dtype)
+    x_pad = x_pad.at[: x.shape[0], :m].set(x)
+    mb = m_pad // tm
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(k, r, mb, c_max),  # c innermost: out block revisited over c only
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, tile, tile), lambda ki, i, j, c, idx_ref: (ki, i, c, 0, 0)),
+            pl.BlockSpec((1, tile, tm), lambda ki, i, j, c, idx_ref: (0, idx_ref[ki, i, c], j)),
+        ],
+        out_specs=pl.BlockSpec((1, tile, tm), lambda ki, i, j, c, idx_ref: (ki, i, j)),
+    )
+    out = pl.pallas_call(
+        _stack_fwd_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((k, r * tile, m_pad), jnp.float32),
+        interpret=interpret,
+    )(idx, data, x_pad[None])
+    return out[:, :n_rows, :m]
+
+
+def _stack_bwd_kernel(idx_t_ref, data_t_ref, g_ref, out_ref):
+    ki = pl.program_id(2)
+    c = pl.program_id(3)
+
+    @pl.when((ki == 0) & (c == 0))
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jnp.dot(
+        data_t_ref[0, 0, 0], g_ref[0], preferred_element_type=jnp.float32
+    )
+
+
+def _stack_bwd_call(data_t, idx_t, g, n_rows, n_cols, tile, interpret):
+    """One launch: ``dx = sum_k A_k^T @ g_k``. ``g``: (K, Nr, M)."""
+    k, r_t, c_max_t = idx_t.shape
+    m = g.shape[2]
+    tm = min(256, _ceil_to(m, TILE))
+    m_pad = _ceil_to(m, tm)
+    g_pad = jnp.zeros((k, _ceil_to(n_rows, tile), m_pad), g.dtype)
+    g_pad = g_pad.at[:, : g.shape[1], :m].set(g)
+    mb = m_pad // tm
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r_t, mb, k, c_max_t),  # (k, c) innermost: accumulate both into out
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, tile, tile), lambda i, j, ki, c, idx_ref: (ki, i, c, 0, 0)),
+            pl.BlockSpec((1, tile, tm), lambda i, j, ki, c, idx_ref: (ki, idx_ref[ki, i, c], j)),
+        ],
+        out_specs=pl.BlockSpec((tile, tm), lambda i, j, ki, c, idx_ref: (i, j)),
+    )
+    out = pl.pallas_call(
+        _stack_bwd_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r_t * tile, m_pad), jnp.float32),
+        interpret=interpret,
+    )(idx_t, data_t, g_pad)
+    return out[:n_cols, :m]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _spmm_stack_vjp(data, idx, data_t, idx_t, x, n_rows, n_cols, tile, interpret):
+    return _stack_fwd_call(data, idx, x, n_rows, n_cols, tile, interpret)
+
+
+def _spmm_stack_fwd(data, idx, data_t, idx_t, x, n_rows, n_cols, tile, interpret):
+    return _stack_fwd_call(data, idx, x, n_rows, n_cols, tile, interpret), (
+        data_t,
+        idx_t,
+    )
+
+
+def _spmm_stack_bwd(n_rows, n_cols, tile, interpret, res, g):
+    data_t, idx_t = res
+    dx = _stack_bwd_call(data_t, idx_t, g, n_rows, n_cols, tile, interpret)
+    return (None, None, None, None, dx)
+
+
+_spmm_stack_vjp.defvjp(_spmm_stack_fwd, _spmm_stack_bwd)
+
+
+def spmm_stack(
+    bss: BlockSparseStack, x: jnp.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """``out[k] = A_k @ x`` for all K supports in one Pallas launch.
+
+    ``x`` is ``(n_cols, M)``; returns ``(K, n_rows, M)`` in float32.
+    Gradients flow to ``x`` only (support cotangents are intentionally
+    dropped — see :func:`spmm`'s warning).
+    """
+    if x.ndim != 2:
+        raise ValueError(f"x must be (N, M), got {x.shape}")
+    if x.shape[0] != bss.n_cols:
+        raise ValueError(f"x has {x.shape[0]} rows, supports expect {bss.n_cols}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _spmm_stack_vjp(
+        bss.data, bss.idx, bss.data_t, bss.idx_t, x,
+        bss.n_rows, bss.n_cols, bss.tile, interpret,
+    )

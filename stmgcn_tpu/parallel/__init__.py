@@ -23,6 +23,7 @@ process groups; collectives within a slice ride ICI and across slices DCN.
 from stmgcn_tpu.parallel.banded import (
     BandedSpec,
     BandedSupports,
+    ShardSpec,
     banded_decompose,
     bandwidth,
     sharded_banded_apply,
@@ -31,11 +32,18 @@ from stmgcn_tpu.parallel.banded import (
 from stmgcn_tpu.parallel.halo import halo_exchange
 from stmgcn_tpu.parallel.mesh import build_mesh, init_distributed, mesh_from_config
 from stmgcn_tpu.parallel.placement import MeshPlacement
+from stmgcn_tpu.parallel.sparse import (
+    ShardedBlockSparse,
+    sharded_from_dense,
+    sharded_spmm_apply,
+)
 
 __all__ = [
     "BandedSpec",
     "BandedSupports",
     "MeshPlacement",
+    "ShardSpec",
+    "ShardedBlockSparse",
     "banded_decompose",
     "bandwidth",
     "build_mesh",
@@ -43,5 +51,7 @@ __all__ = [
     "init_distributed",
     "mesh_from_config",
     "sharded_banded_apply",
+    "sharded_from_dense",
+    "sharded_spmm_apply",
     "strip_decompose",
 ]

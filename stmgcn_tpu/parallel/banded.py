@@ -33,6 +33,7 @@ from stmgcn_tpu.parallel.halo import halo_exchange
 
 __all__ = [
     "BandedSpec",
+    "ShardSpec",
     "BandedSupports",
     "bandwidth",
     "banded_decompose",
@@ -42,12 +43,18 @@ __all__ = [
 
 
 @dataclasses.dataclass(frozen=True)
-class BandedSpec:
-    """Static routing info for banded graph convs (flax module attribute):
-    which mesh to ``shard_map`` over and the name of its region axis."""
+class ShardSpec:
+    """Static routing info for mesh-aware graph convs (flax module
+    attribute): which mesh to ``shard_map`` over and the name of its
+    region axis. Shared by the banded halo plan and the sharded sparse
+    plan (:mod:`stmgcn_tpu.parallel.sparse`)."""
 
     mesh: Mesh
     axis_name: str = "region"
+
+
+#: back-compat alias (the banded plan named it first)
+BandedSpec = ShardSpec
 
 
 @jax.tree_util.register_pytree_node_class

@@ -75,9 +75,11 @@ class MeshPlacement:
 
     def _put_supports(self, supports):
         """Dense ``(M, K, N, N)`` stack, per-branch ``(K, N, N)`` arrays,
-        or :class:`~stmgcn_tpu.parallel.banded.BandedSupports` strips
-        (leading shard axis over region)."""
+        :class:`~stmgcn_tpu.parallel.banded.BandedSupports` strips, or
+        :class:`~stmgcn_tpu.parallel.sparse.ShardedBlockSparse` strips
+        (leading shard axis over region either way)."""
         from stmgcn_tpu.parallel.banded import BandedSupports
+        from stmgcn_tpu.parallel.sparse import ShardedBlockSparse
 
         if isinstance(supports, (tuple, list)):
             return tuple(self._put_supports(s) for s in supports)
@@ -87,6 +89,19 @@ class MeshPlacement:
                 NamedSharding(self.mesh, P("region", None, None, None)),
             )
             return BandedSupports(strips=strips, halo=supports.halo, n=supports.n)
+        if isinstance(supports, ShardedBlockSparse):
+            def shard_leading(a):
+                spec = P("region", *([None] * (a.ndim - 1)))
+                return jax.device_put(jnp.asarray(a), NamedSharding(self.mesh, spec))
+
+            return ShardedBlockSparse(
+                data=shard_leading(supports.data),
+                idx=shard_leading(supports.idx),
+                data_t=shard_leading(supports.data_t),
+                idx_t=shard_leading(supports.idx_t),
+                n=supports.n,
+                tile=supports.tile,
+            )
         arr = jnp.asarray(supports)
         if arr.ndim == 4:  # (M, K, N, N): output-node rows sharded
             spec = self.SPECS["supports"]

@@ -1,0 +1,179 @@
+"""Region-sharded block-sparse support application.
+
+Composes the Pallas block-CSR SpMM (:mod:`stmgcn_tpu.ops.spmm`) with the
+``(dp, region)`` mesh: each region shard stores only its **row strip** of
+every support in block-CSR form (``O(nnz / n_shards)`` memory — the point
+of sparsity at N=2500, where dense ``(K, N, N)`` supports are the
+quadratic blowup SURVEY.md §2 quirk 8 flags), all-gathers the node axis
+of the signal over the region ring, and runs ONE fused-K kernel launch on
+its strip. The batch axis stays partitioned over ``dp`` throughout.
+
+Communication is the same as GSPMD's dense plan (one signal all-gather
+per conv — arbitrary graph structure can touch any column); compute and
+support memory are sparse. For *banded* graphs the halo plan
+(:mod:`stmgcn_tpu.parallel.banded`) moves strictly less data; ``auto``
+region routing prefers it where it applies.
+
+The backward pass needs no hand-written collective: the kernel's custom
+VJP produces this shard's column-contribution ``A_s^T @ g_s`` and
+``shard_map`` transposes the tiled all-gather into the matching
+``psum_scatter`` automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stmgcn_tpu.ops.spmm import (
+    TILE,
+    BlockSparseStack,
+    _assemble_blocks,
+    _scan_blocks,
+    spmm_stack,
+)
+
+__all__ = ["ShardedBlockSparse", "sharded_from_dense", "sharded_spmm_apply"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ShardedBlockSparse:
+    """Per-shard row-strip :class:`BlockSparseStack` s, stacked on a leading
+    shard axis (shardable over ``region`` with one ``NamedSharding``).
+
+    ``data`` ``(S, K, R_loc, C, tile, tile)``, ``idx`` ``(S, K, R_loc, C)``;
+    transpose structure likewise (each strip's ``(N, n_local)`` transpose).
+    """
+
+    data: jnp.ndarray
+    idx: jnp.ndarray
+    data_t: jnp.ndarray
+    idx_t: jnp.ndarray
+    n: int  # global node count
+    tile: int
+
+    def tree_flatten(self):
+        return (self.data, self.idx, self.data_t, self.idx_t), (self.n, self.tile)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, idx, data_t, idx_t = children
+        n, tile = aux
+        return cls(data=data, idx=idx, data_t=data_t, idx_t=idx_t, n=n, tile=tile)
+
+    @property
+    def n_shards(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def n_supports(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def n_local(self) -> int:
+        return self.n // self.n_shards
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.idx.nbytes + self.data_t.nbytes + self.idx_t.nbytes
+
+
+def sharded_from_dense(mats, n_shards: int, tile: int = TILE) -> ShardedBlockSparse:
+    """Split dense ``(K, N, N)`` supports into per-shard block-CSR strips.
+
+    All shards share one ``(c_max, c_max_t)`` so the stacked arrays are
+    uniform (padding rows keep index 0 with zero data, harmless).
+    """
+    mats = np.asarray(mats, dtype=np.float32)
+    k, n, n2 = mats.shape
+    if n != n2:
+        raise ValueError(f"supports must be (K, N, N), got {mats.shape}")
+    if n % n_shards:
+        raise ValueError(f"N={n} not divisible by {n_shards} shards")
+    n_local = n // n_shards
+    # one scan per (shard, support, direction); shared c_max across all
+    # shards and supports so the stacked arrays are uniform, then one
+    # assembly pass (padding rows keep index 0 with zero data, harmless)
+    fwd_scan, bwd_scan = [], []
+    for s in range(n_shards):
+        rows = slice(s * n_local, (s + 1) * n_local)
+        fwd_scan.append([_scan_blocks(mats[ki, rows, :], tile) for ki in range(k)])
+        bwd_scan.append(
+            [_scan_blocks(np.ascontiguousarray(mats[ki, rows, :].T), tile)
+             for ki in range(k)]
+        )
+    occupancy = lambda scans: max(  # noqa: E731 — local helper
+        max(int(nz.sum(axis=1).max()), 1) for per_shard in scans for _, nz in per_shard
+    )
+    c_max, c_max_t = occupancy(fwd_scan), occupancy(bwd_scan)
+
+    def assemble(scans, width):
+        pairs = [
+            [_assemble_blocks(b, nz, width, tile) for b, nz in per_shard]
+            for per_shard in scans
+        ]
+        data = np.stack([np.stack([d for d, _ in per]) for per in pairs])
+        idx = np.stack([np.stack([i for _, i in per]) for per in pairs])
+        return data, idx
+
+    data, idx = assemble(fwd_scan, c_max)
+    data_t, idx_t = assemble(bwd_scan, c_max_t)
+    return ShardedBlockSparse(
+        data=jnp.asarray(data),
+        idx=jnp.asarray(idx),
+        data_t=jnp.asarray(data_t),
+        idx_t=jnp.asarray(idx_t),
+        n=n,
+        tile=tile,
+    )
+
+
+def sharded_spmm_apply(
+    mesh: Mesh,
+    ssp: ShardedBlockSparse,
+    x,
+    axis_name: str = "region",
+    batch_axis: str = "dp",
+) -> jnp.ndarray:
+    """``out[k,b,i,f] = sum_j A_k[i,j] x[b,j,f]`` with node axis sharded and
+    supports stored as per-shard sparse strips. ``x``: ``(B, N, F)``;
+    returns ``(K, B, N, F)`` float32, node axis sharded over ``axis_name``.
+    """
+    b_ax = batch_axis if batch_axis in mesh.shape and mesh.shape[batch_axis] > 1 else None
+    n, n_local = ssp.n, ssp.n_local
+    tile = ssp.tile
+
+    def local(data, idx, data_t, idx_t, x_loc):
+        # leading shard axis arrives as a size-1 block; x_loc: (b, n_loc, F)
+        bss = BlockSparseStack(
+            data=data[0], idx=idx[0], data_t=data_t[0], idx_t=idx_t[0],
+            n_rows=n_local, n_cols=n, tile=tile,
+        )
+        x_full = jax.lax.all_gather(x_loc, axis_name, axis=1, tiled=True)  # (b, N, F)
+        b, _, f = x_full.shape
+        x_mat = x_full.transpose(1, 0, 2).reshape(n, b * f)
+        out = spmm_stack(bss, x_mat)  # (K, n_loc, b*F)
+        return out.reshape(-1, n_local, b, f).transpose(0, 2, 1, 3)  # (K, b, n_loc, F)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(axis_name, None, None, None, None, None),
+            P(axis_name, None, None, None),
+            P(axis_name, None, None, None, None, None),
+            P(axis_name, None, None, None),
+            P(b_ax, axis_name, None),
+        ),
+        out_specs=P(None, b_ax, axis_name, None),
+        # the Pallas call's out_shape carries no varying-mesh-axes metadata,
+        # so shard_map's vma checker cannot see through it
+        check_vma=False,
+    )
+    return fn(ssp.data, ssp.idx, ssp.data_t, ssp.idx_t, jnp.asarray(x))

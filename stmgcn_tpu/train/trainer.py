@@ -46,9 +46,11 @@ __all__ = ["Trainer"]
 
 
 def _contains_blocksparse(supports) -> bool:
-    from stmgcn_tpu.ops.spmm import BlockSparse
+    """Single-device block-CSR forms (mesh-shardable ShardedBlockSparse
+    passes; see stmgcn_tpu/parallel/sparse.py)."""
+    from stmgcn_tpu.ops.spmm import BlockSparse, BlockSparseStack
 
-    if isinstance(supports, BlockSparse):
+    if isinstance(supports, (BlockSparse, BlockSparseStack)):
         return True
     if isinstance(supports, (tuple, list)):
         return any(_contains_blocksparse(s) for s in supports)
@@ -101,13 +103,16 @@ class Trainer:
         # a mesh, the default puts everything on the default device
         self.placement = placement or _DefaultPlacement()
         # supports: dense (M, K, N, N) array, a routed per-branch tuple
-        # (dense / BandedSupports), or a BlockSparse pytree
+        # (dense / BandedSupports / ShardedBlockSparse), or a single-device
+        # block-CSR pytree
         if _contains_blocksparse(supports) and hasattr(self.placement, "mesh"):
             # guard at the seam the config-level check cannot see (explicit
             # placement / direct Trainer construction)
             raise ValueError(
-                "sparse (BlockSparse) supports cannot be mesh-sharded yet — "
-                "pass dense supports or a single-device placement"
+                "single-device block-CSR supports cannot be mesh-sharded — "
+                "route them as ShardedBlockSparse row strips "
+                "(stmgcn_tpu.parallel.sparse.sharded_from_dense) or use a "
+                "single-device placement"
             )
         self.supports = self.placement.put(supports, "supports")
 

@@ -72,8 +72,12 @@ def collective_stats(hlo_text: str) -> dict:
             stats[op]["bytes"] += _shape_bytes(dtype, dims)
         else:
             elems = _TUPLE_SHAPE_RE.findall(tuple_shape)
-            if is_start:  # (operands..., result): result only
-                elems = elems[-1:]
+            if is_start:
+                # TPU async-start tuples are (operands..., result) possibly
+                # followed by scalar u32[] context elements: drop scalars,
+                # then the result is the last remaining element.
+                nonscalar = [e for e in elems if e[1]]
+                elems = (nonscalar or elems)[-1:]
             for dt, dm in elems:
                 stats[op]["bytes"] += _shape_bytes(dt, dm)
     stats["total_bytes"] = sum(v["bytes"] for v in stats.values() if isinstance(v, dict))

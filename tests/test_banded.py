@@ -136,7 +136,7 @@ class TestBandedConvLayer:
         x = jnp.zeros((2, 64, 3))
         with pytest.raises(ValueError, match="supports"):
             conv.init(jax.random.key(0), bsup, x)
-        with pytest.raises(ValueError, match="BandedSpec"):
+        with pytest.raises(ValueError, match="ShardSpec"):
             make_conv("banded", n_supports=3, features=4)
 
 
@@ -157,7 +157,7 @@ class TestMixedModeModel:
                   lstm_hidden_dim=8, lstm_num_layers=2, gcn_hidden_dim=8)
         ref = STMGCN(**kw, vmap_branches=False)
         mixed = STMGCN(**kw, support_modes=("banded", "dense"),
-                       banded_spec=BandedSpec(mesh))
+                       shard_spec=BandedSpec(mesh))
         dense_stack = jnp.asarray(np.stack([sup0, sup1]))
         params = ref.init(jax.random.key(0), dense_stack, jnp.asarray(x))
         want = ref.apply(params, dense_stack, jnp.asarray(x))

@@ -35,11 +35,13 @@ class TestCollectiveStats:
 
     def test_async_pairs_count_once_result_bytes_only(self):
         # TPU HLO splits collectives into -start/-done pairs; the start's
-        # tuple shape is (operand, result) — wire volume is the result.
+        # tuple is (operands..., result) possibly followed by scalar u32[]
+        # context elements (the historical collective-permute-start form) —
+        # wire volume is the result element only.
         hlo = """
   %ags = (f32[1,8]{1,0}, f32[4,8]{1,0}) all-gather-start(%p0)
   %agd = f32[4,8]{1,0} all-gather-done(%ags)
-  %cps = (f32[2,3]{1,0}, f32[2,3]{1,0}) collective-permute-start(%p1)
+  %cps = (f32[2,3]{1,0}, f32[2,3]{1,0}, u32[], u32[]) collective-permute-start(%p1)
   %cpd = f32[2,3]{1,0} collective-permute-done(%cps)
 """
         stats = collective_stats(hlo)

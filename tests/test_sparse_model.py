@@ -108,8 +108,16 @@ class TestSparseExperiment:
         hist = trainer.train()
         assert np.isfinite(hist["train"][0])
 
-    def test_sparse_plus_mesh_rejected(self):
+    def test_sparse_plus_mesh_routes_sharded(self):
+        # round 1 rejected this composition; it now routes to per-shard
+        # block-CSR strips (full coverage in tests/test_sparse_mesh.py)
+        from stmgcn_tpu.experiment import build_dataset, route_supports
+        from stmgcn_tpu.parallel import ShardedBlockSparse
+
         cfg = preset("scaled")
+        cfg.data.rows = 8
+        cfg.data.n_timesteps = 24 * 7 * 2 + 48
         cfg.model.sparse = True
-        with pytest.raises(ValueError, match="sparse mode"):
-            build_trainer(cfg, verbose=False)
+        sup, modes = route_supports(cfg, build_dataset(cfg))
+        assert modes == ("sparse",) * 3
+        assert all(isinstance(s, ShardedBlockSparse) for s in sup)

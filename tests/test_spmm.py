@@ -99,6 +99,71 @@ class TestSpmm:
             spmm(bs, jnp.ones((128,)))
 
 
+class TestBlockSparseStack:
+    """Fused K-support single-launch kernel (spmm_stack)."""
+
+    def make(self, K=3, n=300, m=70, w=40, seed=0):
+        from stmgcn_tpu.ops.spmm import stack_from_dense
+
+        rng = np.random.default_rng(seed)
+        mats = rng.standard_normal((K, n, n)).astype(np.float32)
+        dist = np.abs(np.subtract.outer(np.arange(n), np.arange(n)))
+        mats[:, dist > w] = 0.0
+        x = rng.standard_normal((n, m)).astype(np.float32)
+        return mats, x, stack_from_dense(mats)
+
+    def test_matches_dense_all_k(self):
+        from stmgcn_tpu.ops.spmm import spmm_stack
+
+        mats, x, bss = self.make()
+        got = spmm_stack(bss, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(got), np.einsum("kij,jm->kim", mats, x), rtol=1e-4, atol=1e-4
+        )
+
+    def test_gradient_matches_dense(self):
+        from stmgcn_tpu.ops.spmm import spmm_stack
+
+        mats, x, bss = self.make()
+        c = np.random.default_rng(9).standard_normal((3, 300, 70)).astype(np.float32)
+        g = jax.grad(lambda xx: jnp.sum(spmm_stack(bss, xx) * jnp.asarray(c)))(
+            jnp.asarray(x)
+        )
+        np.testing.assert_allclose(
+            np.asarray(g), np.einsum("kij,kim->jm", mats, c), rtol=1e-4, atol=1e-4
+        )
+
+    def test_rectangular_strip(self):
+        from stmgcn_tpu.ops.spmm import spmm_stack, stack_from_dense
+
+        mats, x, _ = self.make()
+        strip = mats[:, 100:200, :]  # (K, 100, 300) row strip
+        got = spmm_stack(stack_from_dense(strip), jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(got), np.einsum("kij,jm->kim", strip, x), rtol=1e-4, atol=1e-4
+        )
+
+    def test_matches_per_support_loop(self):
+        from stmgcn_tpu.ops.spmm import from_dense, spmm, spmm_stack
+
+        mats, x, bss = self.make(K=2, n=256, w=12)
+        fused = spmm_stack(bss, jnp.asarray(x))
+        for k in range(2):
+            loop = spmm(from_dense(mats[k]), jnp.asarray(x))
+            np.testing.assert_allclose(
+                np.asarray(fused[k]), np.asarray(loop), rtol=1e-5, atol=1e-5
+            )
+
+    def test_shape_validation(self):
+        from stmgcn_tpu.ops.spmm import spmm_stack, stack_from_dense
+
+        _, _, bss = self.make(n=256)
+        with pytest.raises(ValueError, match="rows"):
+            spmm_stack(bss, jnp.ones((128, 8)))
+        with pytest.raises(ValueError, match="\\(K, Nr, Nc\\)"):
+            stack_from_dense(np.ones((4, 5)))
+
+
 class TestSparseChebGraphConv:
     def test_matches_dense_layer_with_same_params(self):
         adj = grid_adjacency(12)  # N=144

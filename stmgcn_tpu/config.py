@@ -41,6 +41,11 @@ class DataConfig:
     cols: Optional[int] = None
     n_timesteps: int = 24 * 7 * 8
     n_cities: int = 1  # >1: samples from several same-shape cities, concatenated
+    #: synthetic multi-city: give every city the first city's graph stack.
+    #: False (default) keeps each city's own graphs — real city pairs
+    #: (BASELINE config 4, Chengdu+Beijing) never share adjacencies, so
+    #: batches then carry a city index and train against per-city supports
+    shared_graphs: bool = False
     dt: int = 1  # hours per timestep (Main.py:10)
     serial_len: int = 3
     daily_len: int = 1

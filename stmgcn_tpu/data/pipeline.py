@@ -43,6 +43,9 @@ class Batch:
     y: np.ndarray
     #: number of *real* (non-padding) samples; == len(y) except for a padded tail
     n_real: int
+    #: which city's graphs this batch belongs to (always 0 when cities
+    #: share one graph stack; batches never mix cities with differing graphs)
+    city: int = 0
 
     def __len__(self) -> int:
         return self.y.shape[0]
@@ -81,17 +84,24 @@ class DemandDataset:
         if len(shapes) != 1:
             raise ValueError(f"cities must share (T, N, C) shape, got {shapes}")
         for d in datas[1:]:
-            if list(d.adjs) != list(datas[0].adjs) or any(
-                not np.array_equal(d.adjs[k], datas[0].adjs[k]) for k in d.adjs
-            ):
+            if list(d.adjs) != list(datas[0].adjs):
                 raise ValueError(
-                    "multi-city training uses one support stack, so all cities "
-                    "must share identical adjacency graphs; got differing graphs "
-                    "(build the cities over a common region structure)"
+                    f"cities must carry the same graph views (adjacency keys), "
+                    f"got {list(datas[0].adjs)} vs {list(d.adjs)}"
                 )
         self.window = window
         self.n_cities = len(datas)
-        self.adjs = datas[0].adjs
+        #: per-city adjacency dicts; real city pairs (BASELINE config 4,
+        #: Chengdu+Beijing) have different graphs, so each batch carries a
+        #: city index and the trainer applies that city's support stack
+        self.city_adjs = [d.adjs for d in datas]
+        #: whether one support stack serves every city (true for a single
+        #: city or synthetic cities built over one region structure)
+        self.shared_graphs = all(
+            all(np.array_equal(d.adjs[k], datas[0].adjs[k]) for k in d.adjs)
+            for d in datas[1:]
+        )
+        self.adjs = datas[0].adjs  # city 0 (the shared stack when shared_graphs)
         self._mode_cache: dict = {}
 
         norm_cls = self._NORMALIZERS[normalize]
@@ -150,14 +160,25 @@ class DemandDataset:
             )
         return self._mode_cache[mode]
 
+    def city_arrays(self, mode: str, city: int) -> tuple[np.ndarray, np.ndarray]:
+        """One city's ``(x, y)`` views for a mode."""
+        start, stop = self.split.range_for(mode)
+        return self._xs[city][start:stop], self._ys[city][start:stop]
+
     def denormalize(self, values):
         if self.normalizer is None:
             return values
         return self.normalizer.inverse(values)
 
     def num_batches(self, mode: str, batch_size: int, drop_last: bool = False) -> int:
-        n = self.mode_size(mode)
-        return n // batch_size if drop_last else -(-n // batch_size)
+        per = self.split.mode_len[mode]
+        if self.shared_graphs:
+            n = per * self.n_cities
+            return n // batch_size if drop_last else -(-n // batch_size)
+        # differing graphs: batches never span cities, so each city's tail
+        # rounds (or drops) independently
+        one = per // batch_size if drop_last else -(-per // batch_size)
+        return one * self.n_cities
 
     def batches(
         self,
@@ -176,14 +197,33 @@ class DemandDataset:
         batch has the same static shape under ``jit``; ``Batch.n_real`` lets
         the loss/metrics mask the padding. ``shuffle`` reshuffles per epoch
         with a deterministic ``(seed, epoch)`` stream.
+
+        With per-city graphs (``shared_graphs=False``) batches never mix
+        cities — every batch carries the ``city`` whose support stack
+        applies to it; shuffling permutes within each city.
         """
         if drop_last and pad_last:
             raise ValueError("drop_last and pad_last are mutually exclusive")
-        x, y = self.arrays(mode)
+        if self.shared_graphs:
+            yield from self._iter_arrays(
+                self.arrays(mode), 0, batch_size, shuffle, (seed,), epoch,
+                drop_last, pad_last,
+            )
+            return
+        for city in range(self.n_cities):
+            yield from self._iter_arrays(
+                self.city_arrays(mode, city), city, batch_size, shuffle,
+                (seed, city), epoch, drop_last, pad_last,
+            )
+
+    def _iter_arrays(
+        self, arrays, city, batch_size, shuffle, seed_key, epoch, drop_last, pad_last
+    ) -> Iterator[Batch]:
+        x, y = arrays
         n = y.shape[0]
         order = None
         if shuffle:
-            order = np.random.default_rng((seed, epoch)).permutation(n)
+            order = np.random.default_rng((*seed_key, epoch)).permutation(n)
         stop = n - n % batch_size if drop_last else n
         for i in range(0, stop, batch_size):
             idx = slice(i, min(i + batch_size, n))
@@ -193,4 +233,4 @@ class DemandDataset:
                 reps = batch_size - n_real
                 bx = np.concatenate([bx, np.repeat(bx[-1:], reps, axis=0)])
                 by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
-            yield Batch(x=bx, y=by, n_real=n_real)
+            yield Batch(x=bx, y=by, n_real=n_real, city=city)

@@ -57,11 +57,12 @@ def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
             )
             for c in range(d.n_cities)
         ]
-        # One support stack serves all branches, so synthetic cities share the
-        # region-graph structure (distinct demand, common graphs) — the DP
-        # mesh axis is what the multicity config exercises.
-        for c in cities[1:]:
-            c.adjs = cities[0].adjs
+        if d.shared_graphs:
+            # optionally collapse to one region-graph structure (distinct
+            # demand, common graphs) — lets every support representation
+            # (banded/sparse mesh routing) apply across cities
+            for c in cities[1:]:
+                c.adjs = cities[0].adjs
     n_samples = window.n_samples(cities[0].demand.shape[0])
     if d.dates is not None:
         split = date_splits(
@@ -84,14 +85,24 @@ def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
 
     Dense mode: one stacked ``(M, n_supports, N, N)`` array. Sparse mode:
     an M-tuple of :class:`~stmgcn_tpu.ops.spmm.BlockSparseStack` — each
-    branch's K supports in one fused-launch block-CSR structure.
+    branch's K supports in one fused-launch block-CSR structure. When the
+    dataset's cities carry differing graphs, the result is a
+    :class:`~stmgcn_tpu.train.CitySupports` of one such stack per city.
     """
-    dense = cfg.model.support_config.build_all(dataset.adjs.values())
-    if not cfg.model.sparse:
-        return dense
-    from stmgcn_tpu.ops.spmm import stack_from_dense
 
-    return tuple(stack_from_dense(dense[m]) for m in range(dense.shape[0]))
+    def one(adjs):
+        dense = cfg.model.support_config.build_all(adjs.values())
+        if not cfg.model.sparse:
+            return dense
+        from stmgcn_tpu.ops.spmm import stack_from_dense
+
+        return tuple(stack_from_dense(dense[m]) for m in range(dense.shape[0]))
+
+    if not dataset.shared_graphs:
+        from stmgcn_tpu.train import CitySupports
+
+        return CitySupports(one(adjs) for adjs in dataset.city_adjs)
+    return one(dataset.adjs)
 
 
 def _strategy_active(cfg: ExperimentConfig) -> bool:
@@ -120,6 +131,15 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
       supports as :class:`~stmgcn_tpu.parallel.sparse.ShardedBlockSparse`
       row strips over the region axis.
     """
+    if not dataset.shared_graphs and (
+        (cfg.model.sparse and cfg.mesh.n_devices > 1) or _strategy_active(cfg)
+    ):
+        raise ValueError(
+            "per-city graphs currently compose with dense GSPMD or "
+            "single-device sparse supports only — set "
+            "data.shared_graphs=True, region_strategy='gspmd', or dense "
+            "mode for multi-city mesh configs"
+        )
     if cfg.model.sparse and cfg.mesh.n_devices > 1:
         from stmgcn_tpu.parallel.sparse import sharded_from_dense
 

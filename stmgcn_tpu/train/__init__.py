@@ -11,9 +11,10 @@ semantics match the reference exactly (``Model_Trainer.py:47-60``).
 from stmgcn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
 from stmgcn_tpu.train.metrics import MAE, MAPE, MSE, PCC, RMSE, regression_report
 from stmgcn_tpu.train.step import StepFns, make_optimizer, make_step_fns
-from stmgcn_tpu.train.trainer import Trainer
+from stmgcn_tpu.train.trainer import CitySupports, Trainer
 
 __all__ = [
+    "CitySupports",
     "MAE",
     "MAPE",
     "MSE",

@@ -45,6 +45,30 @@ from stmgcn_tpu.train.step import make_optimizer, make_step_fns
 __all__ = ["Trainer"]
 
 
+class CitySupports:
+    """Per-city support stacks for multi-city training with differing
+    graphs (BASELINE config 4: real city pairs do not share adjacencies).
+
+    Batches never mix cities (``Batch.city``); the trainer applies
+    ``for_city(batch.city)`` per step. City stacks share shapes, so one
+    compiled step serves every city.
+    """
+
+    def __init__(self, per_city):
+        self.per_city = tuple(per_city)
+        if not self.per_city:
+            raise ValueError("need at least one city's supports")
+
+    def __len__(self) -> int:
+        return len(self.per_city)
+
+    def for_city(self, city: int):
+        return self.per_city[city]
+
+    def map(self, fn) -> "CitySupports":
+        return CitySupports(fn(s) for s in self.per_city)
+
+
 def _contains_blocksparse(supports) -> bool:
     """Single-device block-CSR forms (mesh-shardable ShardedBlockSparse
     passes; see stmgcn_tpu/parallel/sparse.py)."""
@@ -103,9 +127,12 @@ class Trainer:
         # a mesh, the default puts everything on the default device
         self.placement = placement or _DefaultPlacement()
         # supports: dense (M, K, N, N) array, a routed per-branch tuple
-        # (dense / BandedSupports / ShardedBlockSparse), or a single-device
-        # block-CSR pytree
-        if _contains_blocksparse(supports) and hasattr(self.placement, "mesh"):
+        # (dense / BandedSupports / ShardedBlockSparse), a single-device
+        # block-CSR pytree, or CitySupports wrapping one of those per city
+        each = supports.per_city if isinstance(supports, CitySupports) else (supports,)
+        if any(_contains_blocksparse(s) for s in each) and hasattr(
+            self.placement, "mesh"
+        ):
             # guard at the seam the config-level check cannot see (explicit
             # placement / direct Trainer construction)
             raise ValueError(
@@ -114,7 +141,10 @@ class Trainer:
                 "(stmgcn_tpu.parallel.sparse.sharded_from_dense) or use a "
                 "single-device placement"
             )
-        self.supports = self.placement.put(supports, "supports")
+        if isinstance(supports, CitySupports):
+            self.supports = supports.map(lambda s: self.placement.put(s, "supports"))
+        else:
+            self.supports = self.placement.put(supports, "supports")
 
         for mode in ("train", "validate"):
             if dataset.mode_size(mode) == 0:
@@ -125,7 +155,9 @@ class Trainer:
         self.step_fns = make_step_fns(model, make_optimizer(lr, weight_decay), loss)
         example = next(dataset.batches("train", batch_size, pad_last=True))
         self.params, self.opt_state = self.step_fns.init(
-            jax.random.key(seed), self.supports, self.placement.put(example.x, "x")
+            jax.random.key(seed),
+            self._supports_for(example),
+            self.placement.put(example.x, "x"),
         )
         self.params = self.placement.put(self.params, "state")
         self.opt_state = self.placement.put(self.opt_state, "state")
@@ -180,6 +212,13 @@ class Trainer:
         meta.update(self.extra_meta)
         return meta
 
+    def _supports_for(self, batch):
+        """The support stack that applies to a batch (per-city when graphs
+        differ across cities; Batch.city is 0 otherwise)."""
+        if isinstance(self.supports, CitySupports):
+            return self.supports.for_city(batch.city)
+        return self.supports
+
     def _place_batch(self, batch):
         x = self.placement.put(batch.x, "x")
         y = self.placement.put(batch.y, "y")
@@ -205,12 +244,13 @@ class Trainer:
             pad_last=True,
         ):
             x, y, mask = self._place_batch(batch)
+            sup = self._supports_for(batch)
             if train:
                 self.params, self.opt_state, loss = self.step_fns.train_step(
-                    self.params, self.opt_state, self.supports, x, y, mask
+                    self.params, self.opt_state, sup, x, y, mask
                 )
             else:
-                loss, _ = self.step_fns.eval_step(self.params, self.supports, x, y, mask)
+                loss, _ = self.step_fns.eval_step(self.params, sup, x, y, mask)
             losses.append(loss)
             counts.append(batch.n_real)
         if not counts:
@@ -315,7 +355,9 @@ class Trainer:
             preds, trues = [], []
             for batch in self.dataset.batches(mode, self.batch_size, pad_last=True):
                 x, y, mask = self._place_batch(batch)
-                _, pred = self.step_fns.eval_step(params, self.supports, x, y, mask)
+                _, pred = self.step_fns.eval_step(
+                    params, self._supports_for(batch), x, y, mask
+                )
                 preds.append(np.asarray(pred)[: batch.n_real])
                 trues.append(batch.y[: batch.n_real])
             pred = self.dataset.denormalize(np.concatenate(preds, axis=0))

@@ -113,6 +113,9 @@ class TrainConfig:
     patience: int = 10
     top_k: int = 1  # best improvement snapshots kept alongside best/latest
     shuffle: bool = False  # reference parity (Data_Container.py:122)
+    #: batches placed on device ahead of the consuming step (0 disables);
+    #: overlaps host->device copies with device compute
+    prefetch: int = 1
     seed: int = 0
     out_dir: str = "output"
 

@@ -264,6 +264,7 @@ def build_trainer(
         batch_size=t.batch_size,
         patience=t.patience,
         top_k=t.top_k,
+        prefetch=t.prefetch,
         shuffle=t.shuffle,
         seed=t.seed,
         out_dir=t.out_dir,

@@ -109,6 +109,7 @@ class Trainer:
         seed: int = 0,
         out_dir: str = "output",
         top_k: int = 1,
+        prefetch: int = 1,
         placement=None,
         extra_meta: Optional[dict] = None,
         verbose: bool = True,
@@ -121,6 +122,9 @@ class Trainer:
         self.shuffle = shuffle
         self.seed = seed
         self.out_dir = out_dir
+        if prefetch < 0:
+            raise ValueError("prefetch must be >= 0 (batches placed ahead)")
+        self.prefetch = prefetch
         self.verbose = verbose
         self.extra_meta = extra_meta or {}
         # device placement hook; stmgcn_tpu.parallel.MeshPlacement shards over
@@ -219,6 +223,33 @@ class Trainer:
             return self.supports.for_city(batch.city)
         return self.supports
 
+    def _placed_batches(self, mode: str, *, shuffle: bool = False):
+        """Iterate ``(batch, (x, y, mask))`` with placement run ahead.
+
+        ``device_put`` issues the host->device copy asynchronously, so
+        placing the *next* batch before the consumer dispatches the current
+        step overlaps the copy with device compute — placement leaves the
+        step's critical path (the reference instead uploads whole splits
+        eagerly, ``Data_Container.py:88-89``). ``prefetch`` batches are kept
+        in flight (host refs released as consumed).
+        """
+        import collections
+
+        queue: collections.deque = collections.deque()
+        for batch in self.dataset.batches(
+            mode,
+            self.batch_size,
+            shuffle=shuffle,
+            seed=self.seed,
+            epoch=self.epoch,
+            pad_last=True,
+        ):
+            queue.append((batch, self._place_batch(batch)))
+            if len(queue) > self.prefetch:
+                yield queue.popleft()
+        while queue:
+            yield queue.popleft()
+
     def _place_batch(self, batch):
         x = self.placement.put(batch.x, "x")
         y = self.placement.put(batch.y, "y")
@@ -235,15 +266,9 @@ class Trainer:
         host batch prep with device compute.
         """
         losses, counts = [], []
-        for batch in self.dataset.batches(
-            mode,
-            self.batch_size,
-            shuffle=self.shuffle and train,
-            seed=self.seed,
-            epoch=self.epoch,
-            pad_last=True,
+        for batch, (x, y, mask) in self._placed_batches(
+            mode, shuffle=self.shuffle and train
         ):
-            x, y, mask = self._place_batch(batch)
             sup = self._supports_for(batch)
             if train:
                 self.params, self.opt_state, loss = self.step_fns.train_step(
@@ -353,8 +378,7 @@ class Trainer:
         results = {}
         for mode in modes:
             preds, trues = [], []
-            for batch in self.dataset.batches(mode, self.batch_size, pad_last=True):
-                x, y, mask = self._place_batch(batch)
+            for batch, (x, y, mask) in self._placed_batches(mode):
                 _, pred = self.step_fns.eval_step(
                     params, self._supports_for(batch), x, y, mask
                 )

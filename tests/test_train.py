@@ -289,6 +289,20 @@ class TestConfigAndExperiment:
         assert np.isfinite(hist["train"]).all()
         assert np.isfinite(tr.test(modes=("test",))["test"]["rmse"])
 
+    def test_prefetch_does_not_change_results(self, tmp_path):
+        """Placement lookahead is a pure pipelining change: identical loss
+        trajectories with prefetch disabled, default, and deep."""
+        losses = {}
+        for pf in (0, 1, 3):
+            cfg = preset("smoke")
+            cfg.data.n_timesteps = 24 * 7 * 2 + 48
+            cfg.train.epochs = 2
+            cfg.train.prefetch = pf
+            cfg.train.out_dir = str(tmp_path / f"pf{pf}")
+            losses[pf] = build_trainer(cfg, verbose=False).train()
+        np.testing.assert_allclose(losses[0]["validate"], losses[1]["validate"])
+        np.testing.assert_allclose(losses[0]["validate"], losses[3]["validate"])
+
     def test_multicity_shared_graphs_knob(self):
         cfg = preset("multicity")
         cfg.data.n_timesteps = 24 * 7 * 2 + 48

@@ -182,19 +182,20 @@ def _default() -> ExperimentConfig:
 
 
 def _scaled() -> ExperimentConfig:
-    """BASELINE config 3: 50x50 grid, K=3, region axis sharded.
+    """BASELINE config 3: 50x50 grid, K=3, region axis sharded across 8.
 
-    ``(dp=2, region=4)`` over 8 chips: N=2500 divides by 4 (625-node
-    shards), not by 8. ``region_strategy="auto"`` puts the banded grid
-    branch on the explicit halo plan (cheb-K3 bandwidth 150 << 625) and
-    the non-banded transport/similarity branches on GSPMD.
+    N=2500 does not divide region=8 — the node axis carries 4 zero-padded
+    rows (2504 = 8 x 313; isolated nodes, masked out of gate/loss/metrics).
+    ``region_strategy="auto"`` puts the banded grid branch on the explicit
+    halo plan (cheb-K3 bandwidth 150 <= shard 313 // 2 = 156) and the
+    non-banded transport/similarity branches on GSPMD.
     """
     return ExperimentConfig(
         name="scaled",
         data=DataConfig(rows=50, n_timesteps=24 * 7 * 4),
         model=ModelConfig(K=3, dtype="bfloat16"),
         train=TrainConfig(batch_size=16),
-        mesh=MeshConfig(dp=2, region=4, region_strategy="auto"),
+        mesh=MeshConfig(region=8, region_strategy="auto"),
     )
 
 

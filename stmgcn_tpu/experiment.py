@@ -80,6 +80,43 @@ def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
     )
 
 
+def node_pad_target(cfg: ExperimentConfig, n_nodes: int):
+    """Padded node count for a region mesh that does not divide ``N``
+    (``None`` when no padding is needed).
+
+    Supports are built at the true ``N`` and then zero-padded — padding the
+    *adjacency* instead would change the Laplacian spectrum (the
+    ``2L/λmax − I`` rescale, ``GCN.py:113-123``) and silently alter the
+    model at real nodes. Padded rows are isolated: zero support rows/cols,
+    zero inputs, excluded from the gate pooling (``STMGCN.n_real_nodes``)
+    and from the loss via the ``(B, N)`` mask.
+    """
+    region = cfg.mesh.region
+    if cfg.mesh.n_devices > 1 and region > 1 and n_nodes % region:
+        return -(-n_nodes // region) * region
+    return None
+
+
+def _pad_support_nodes(dense, n_pad: int):
+    """Zero-pad the trailing two (node) axes of a dense support stack."""
+    import numpy as np
+
+    dense = np.asarray(dense)
+    extra = n_pad - dense.shape[-1]
+    if extra <= 0:
+        return dense
+    widths = [(0, 0)] * (dense.ndim - 2) + [(0, extra), (0, extra)]
+    return np.pad(dense, widths)
+
+
+def _dense_supports(cfg: ExperimentConfig, adjs, n_nodes: int):
+    """One city's dense support stack, node-padded iff the mesh needs it —
+    the single padding site every support representation derives from."""
+    dense = cfg.model.support_config.build_all(adjs.values())
+    n_pad = node_pad_target(cfg, n_nodes)
+    return _pad_support_nodes(dense, n_pad) if n_pad is not None else dense
+
+
 def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
     """Supports from the dataset's graphs.
 
@@ -88,10 +125,12 @@ def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
     branch's K supports in one fused-launch block-CSR structure. When the
     dataset's cities carry differing graphs, the result is a
     :class:`~stmgcn_tpu.train.CitySupports` of one such stack per city.
+    On a region mesh that does not divide ``N``, the node axes carry zero
+    padding (see :func:`node_pad_target`).
     """
 
     def one(adjs):
-        dense = cfg.model.support_config.build_all(adjs.values())
+        dense = _dense_supports(cfg, adjs, dataset.n_nodes)
         if not cfg.model.sparse:
             return dense
         from stmgcn_tpu.ops.spmm import stack_from_dense
@@ -143,7 +182,7 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
     if cfg.model.sparse and cfg.mesh.n_devices > 1:
         from stmgcn_tpu.parallel.sparse import sharded_from_dense
 
-        dense = cfg.model.support_config.build_all(dataset.adjs.values())
+        dense = _dense_supports(cfg, dataset.adjs, dataset.n_nodes)
         routed = tuple(
             sharded_from_dense(dense[m], cfg.mesh.region)
             for m in range(dense.shape[0])
@@ -157,7 +196,7 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
     from stmgcn_tpu.parallel.banded import banded_decompose, bandwidth
 
     region = cfg.mesh.region
-    n = dataset.n_nodes
+    n = supports.shape[-1]  # node-padded when the mesh required it
     if n % region:
         raise ValueError(f"n_nodes {n} not divisible by region={region}")
     n_local = n // region
@@ -186,6 +225,7 @@ def build_model(
     input_dim: int,
     support_modes=None,
     shard_spec=None,
+    n_real_nodes=None,
 ) -> STMGCN:
     """Model from config + the one data-derived scalar (feature count).
 
@@ -215,6 +255,7 @@ def build_model(
         sparse=m.sparse and support_modes is None,
         support_modes=support_modes,
         shard_spec=shard_spec,
+        n_real_nodes=n_real_nodes,
         vmap_branches=not _strategy_active(cfg),
         remat=m.remat,
         dtype=m.compute_dtype if m.dtype != "float32" else None,
@@ -249,14 +290,24 @@ def build_trainer(
                 "placement (mesh.n_devices > 1 with visible devices)"
             )
         shard_spec = ShardSpec(mesh=placement.mesh)
-    model = build_model(cfg, dataset.n_feats, support_modes, shard_spec)
+    n_pad = node_pad_target(cfg, dataset.n_nodes)
+    model = build_model(
+        cfg,
+        dataset.n_feats,
+        support_modes,
+        shard_spec,
+        n_real_nodes=dataset.n_nodes if n_pad is not None else None,
+    )
     if placement is not None and hasattr(placement, "check_divisibility"):
-        placement.check_divisibility(cfg.train.batch_size, dataset.n_nodes)
+        placement.check_divisibility(
+            cfg.train.batch_size, n_pad if n_pad is not None else dataset.n_nodes
+        )
     t = cfg.train
     return Trainer(
         model,
         dataset,
         supports,
+        node_pad=(n_pad - dataset.n_nodes) if n_pad is not None else 0,
         lr=t.lr,
         weight_decay=t.weight_decay,
         loss=t.loss,

@@ -1,6 +1,14 @@
 """Model layer: contextual-gated LSTM branches and the ST-MGCN flagship."""
 
 from stmgcn_tpu.models.cg_lstm import CGLSTM, ContextualGate
+from stmgcn_tpu.models.params import to_looped_params, to_vmapped_params
 from stmgcn_tpu.models.st_mgcn import STMGCN, Branch
 
-__all__ = ["CGLSTM", "ContextualGate", "STMGCN", "Branch"]
+__all__ = [
+    "Branch",
+    "CGLSTM",
+    "ContextualGate",
+    "STMGCN",
+    "to_looped_params",
+    "to_vmapped_params",
+]

@@ -45,6 +45,11 @@ class ContextualGate(nn.Module):
     #: gate's graph conv consumes (see stmgcn_tpu.ops.chebconv.conv_cls)
     support_mode: str = "dense"
     shard_spec: Any = None
+    #: when the node axis carries mesh-divisibility padding, the number of
+    #: real nodes — eq. 7's node pooling then excludes padded rows (whose
+    #: conv bias would otherwise shift the gate), keeping the padded model
+    #: numerically identical to the unpadded one
+    n_real_nodes: Optional[int] = None
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -65,7 +70,14 @@ class ContextualGate(nn.Module):
             name="temporal_gconv",
         )(supports, x_nt)
         x_hat = x_nt + g  # eq. 6 residual
-        z = x_hat.mean(axis=1)  # eq. 7: average pool over nodes -> (B, T)
+        n_nodes = x_hat.shape[1]
+        if self.n_real_nodes is not None and self.n_real_nodes != n_nodes:
+            # eq. 7 over real nodes only (masked mean; a static slice would
+            # fight the region sharding, a broadcast-multiply does not)
+            node_mask = (jnp.arange(n_nodes) < self.n_real_nodes).astype(x_hat.dtype)
+            z = (x_hat * node_mask[None, :, None]).sum(axis=1) / self.n_real_nodes
+        else:
+            z = x_hat.mean(axis=1)  # eq. 7: average pool over nodes -> (B, T)
 
         fc = nn.Dense(
             self.seq_len, dtype=self.dtype, param_dtype=self.param_dtype, name="gate_fc"
@@ -94,6 +106,7 @@ class CGLSTM(nn.Module):
     shared_gate_fc: bool = True
     support_mode: str = "dense"
     shard_spec: Any = None
+    n_real_nodes: Optional[int] = None
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
@@ -109,6 +122,7 @@ class CGLSTM(nn.Module):
             shared_gate_fc=self.shared_gate_fc,
             support_mode=self.support_mode,
             shard_spec=self.shard_spec,
+            n_real_nodes=self.n_real_nodes,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="gate",

@@ -1,0 +1,63 @@
+"""Branch-parameter layout conversion.
+
+The flagship stores its M branch parameters in one of two layouts:
+
+- **vmapped** (``vmap_branches=True``, all-dense supports): one
+  ``branches`` subtree whose every leaf carries a leading ``(M, ...)``
+  axis (``nn.vmap`` with ``variable_axes={'params': 0}``);
+- **looped** (sparse / routed / ``vmap_branches=False``): subtrees
+  ``branch_0 .. branch_{M-1}`` with per-branch leaves.
+
+The layouts are informationally identical — these converters make
+checkpoints interchangeable across them (e.g. continue a GSPMD-trained
+vmapped run under the banded region strategy, or serve a sparse-trained
+checkpoint with the vmapped dense model). Non-branch subtrees (the
+``head``) pass through untouched.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_looped_params", "to_vmapped_params"]
+
+_VMAPPED_KEY = "branches"
+
+
+def _branch_keys(m_graphs: int):
+    return [f"branch_{m}" for m in range(m_graphs)]
+
+
+def to_vmapped_params(variables, m_graphs: int):
+    """Looped ``branch_0..branch_{M-1}`` layout -> vmapped ``branches``."""
+    params = dict(variables["params"])
+    keys = _branch_keys(m_graphs)
+    missing = [k for k in keys if k not in params]
+    if missing:
+        raise ValueError(
+            f"not a looped-layout checkpoint: missing subtree(s) {missing}"
+        )
+    per_branch = [params.pop(k) for k in keys]
+    params[_VMAPPED_KEY] = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_branch
+    )
+    return {**variables, "params": params}
+
+
+def to_looped_params(variables, m_graphs: int):
+    """Vmapped ``branches`` layout -> looped ``branch_0..branch_{M-1}``."""
+    params = dict(variables["params"])
+    if _VMAPPED_KEY not in params:
+        raise ValueError(
+            f"not a vmapped-layout checkpoint: no {_VMAPPED_KEY!r} subtree"
+        )
+    stacked = params.pop(_VMAPPED_KEY)
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked)}
+    if leading != {m_graphs}:
+        raise ValueError(
+            f"stacked branch axis is {sorted(leading)}, expected {{{m_graphs}}}"
+        )
+    for m, key in enumerate(_branch_keys(m_graphs)):
+        params[key] = jax.tree.map(lambda leaf, m=m: leaf[m], stacked)
+    return {**variables, "params": params}

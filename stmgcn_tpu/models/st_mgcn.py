@@ -42,6 +42,7 @@ class Branch(nn.Module):
     #: "banded" (stmgcn_tpu.ops.chebconv.conv_cls)
     support_mode: str = "dense"
     shard_spec: Any = None
+    n_real_nodes: Optional[int] = None
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
@@ -58,6 +59,7 @@ class Branch(nn.Module):
             shared_gate_fc=self.shared_gate_fc,
             support_mode=self.support_mode,
             shard_spec=self.shard_spec,
+            n_real_nodes=self.n_real_nodes,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -109,6 +111,10 @@ class STMGCN(nn.Module):
     #: static mesh/axis routing for "banded" branches and mesh-sharded
     #: "sparse" branches
     shard_spec: Any = None
+    #: real node count when the node axis carries mesh-divisibility
+    #: padding (None = no padding); gate pooling and nothing else depends
+    #: on it — padded rows are excluded from the loss by the (B, N) mask
+    n_real_nodes: Optional[int] = None
     vmap_branches: bool = True
     remat: bool = False
     dtype: Optional[Any] = None
@@ -139,6 +145,7 @@ class STMGCN(nn.Module):
             shared_gate_fc=self.shared_gate_fc,
             support_mode=mode,
             shard_spec=self.shard_spec if mode in ("banded", "sparse") else None,
+            n_real_nodes=self.n_real_nodes,
             remat=self.remat,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

@@ -49,6 +49,9 @@ class MeshPlacement:
         if kind == "y" and ndim == 4:
             # seq2seq targets (B, H, N, C): region stays on the node axis
             return P("dp", None, "region", None)
+        if kind == "mask" and ndim == 2:
+            # (B, N) sample x node mask (node-padded meshes)
+            return P("dp", "region")
         return self.SPECS[kind]
 
     def sharding(self, kind: str, ndim: int = 3) -> NamedSharding:

@@ -67,9 +67,11 @@ def make_step_fns(
 ) -> StepFns:
     """Build jitted init/train/eval steps for a flax model.
 
-    ``mask`` is a ``(B,)`` 0/1 vector (1 = real sample); the loss is the
-    mean over real elements only, so a padded tail batch yields exactly the
-    loss of its ragged equivalent.
+    ``mask`` is a ``(B,)`` 0/1 vector (1 = real sample) or, when the node
+    axis carries mesh-divisibility padding, a ``(B, N)`` 0/1 matrix
+    (sample x real-node); the loss is the mean over real elements only, so
+    padded tail batches and padded nodes yield exactly the loss of the
+    unpadded equivalent.
     """
     if loss not in LOSSES:
         raise ValueError(f"loss must be one of {LOSSES}, got {loss!r}")
@@ -77,10 +79,15 @@ def make_step_fns(
     def loss_fn(params, supports, x, y, mask):
         pred = model.apply(params, supports, x)
         err = _elementwise_loss(loss, pred.astype(jnp.float32), y.astype(jnp.float32))
-        # y is (B, N, C) single-step or (B, H, N, C) seq2seq; weight per sample
-        w = mask.reshape(mask.shape + (1,) * (y.ndim - 1))
-        per_sample_elems = math.prod(y.shape[1:])
-        return (err * w).sum() / (mask.sum() * per_sample_elems), pred
+        # y is (B, N, C) single-step or (B, H, N, C) seq2seq
+        if mask.ndim == 1:  # (B,): per-sample weights
+            w = mask.reshape(mask.shape + (1,) * (y.ndim - 1))
+            denom = mask.sum() * math.prod(y.shape[1:])
+        else:  # (B, N): sample x node weights (padded node axis on a mesh)
+            w = mask[:, None, :, None] if y.ndim == 4 else mask[:, :, None]
+            per_node_elems = y.shape[-1] * (y.shape[1] if y.ndim == 4 else 1)
+            denom = mask.sum() * per_node_elems
+        return (err * w).sum() / denom, pred
 
     def init(rng, supports, x):
         params = model.init(rng, supports, x)

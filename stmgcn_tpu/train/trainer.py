@@ -110,6 +110,7 @@ class Trainer:
         out_dir: str = "output",
         top_k: int = 1,
         prefetch: int = 1,
+        node_pad: int = 0,
         placement=None,
         extra_meta: Optional[dict] = None,
         verbose: bool = True,
@@ -125,6 +126,12 @@ class Trainer:
         if prefetch < 0:
             raise ValueError("prefetch must be >= 0 (batches placed ahead)")
         self.prefetch = prefetch
+        if node_pad < 0:
+            raise ValueError("node_pad must be >= 0 (padded node rows)")
+        #: extra zero nodes appended so N divides the mesh's region axis;
+        #: padded rows are isolated (zero supports), excluded from the gate
+        #: pooling (model.n_real_nodes) and masked out of the loss/metrics
+        self.node_pad = node_pad
         self.verbose = verbose
         self.extra_meta = extra_meta or {}
         # device placement hook; stmgcn_tpu.parallel.MeshPlacement shards over
@@ -158,10 +165,9 @@ class Trainer:
                 )
         self.step_fns = make_step_fns(model, make_optimizer(lr, weight_decay), loss)
         example = next(dataset.batches("train", batch_size, pad_last=True))
+        example_x, _, _ = self._place_batch(example)  # node-padded when needed
         self.params, self.opt_state = self.step_fns.init(
-            jax.random.key(seed),
-            self._supports_for(example),
-            self.placement.put(example.x, "x"),
+            jax.random.key(seed), self._supports_for(example), example_x
         )
         self.params = self.placement.put(self.params, "state")
         self.opt_state = self.placement.put(self.opt_state, "state")
@@ -251,12 +257,28 @@ class Trainer:
             yield queue.popleft()
 
     def _place_batch(self, batch):
-        x = self.placement.put(batch.x, "x")
-        y = self.placement.put(batch.y, "y")
-        mask = self.placement.put(
-            (np.arange(len(batch)) < batch.n_real).astype(np.float32), "mask"
+        bx, by = batch.x, batch.y
+        sample_mask = (np.arange(len(batch)) < batch.n_real).astype(np.float32)
+        if self.node_pad:
+            node_axis_x, node_axis_y = 2, by.ndim - 2  # (B,T,N,C); (B,[H,]N,C)
+            bx = self._pad_nodes(bx, node_axis_x)
+            by = self._pad_nodes(by, node_axis_y)
+            node_mask = (
+                np.arange(by.shape[node_axis_y]) < by.shape[node_axis_y] - self.node_pad
+            ).astype(np.float32)
+            mask = sample_mask[:, None] * node_mask[None, :]
+        else:
+            mask = sample_mask
+        return (
+            self.placement.put(bx, "x"),
+            self.placement.put(by, "y"),
+            self.placement.put(mask, "mask"),
         )
-        return x, y, mask
+
+    def _pad_nodes(self, arr, axis: int):
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, self.node_pad)
+        return np.pad(arr, widths)
 
     def _run_epoch(self, mode: str, train: bool) -> float:
         """Sample-weighted mean loss over a mode (``Model_Trainer.py:43-44``).
@@ -382,7 +404,10 @@ class Trainer:
                 _, pred = self.step_fns.eval_step(
                     params, self._supports_for(batch), x, y, mask
                 )
-                preds.append(np.asarray(pred)[: batch.n_real])
+                pred = np.asarray(pred)[: batch.n_real]
+                if self.node_pad:  # drop padded node rows ((B,[H,]N,C))
+                    pred = pred[..., : -self.node_pad, :]
+                preds.append(pred)
                 trues.append(batch.y[: batch.n_real])
             pred = self.dataset.denormalize(np.concatenate(preds, axis=0))
             true = self.dataset.denormalize(np.concatenate(trues, axis=0))

@@ -1,0 +1,54 @@
+"""Branch-param layout converters: vmapped <-> looped checkpoints."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.models import STMGCN, to_looped_params, to_vmapped_params
+
+KW = dict(m_graphs=2, n_supports=3, seq_len=5, input_dim=1,
+          lstm_hidden_dim=8, lstm_num_layers=2, gcn_hidden_dim=8)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(0)
+    sup = jnp.asarray((rng.normal(size=(2, 3, 16, 16)) * 0.2).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(4, 5, 16, 1)).astype(np.float32))
+    return sup, x
+
+
+def test_roundtrip_identity(problem):
+    sup, x = problem
+    vm = STMGCN(**KW).init(jax.random.key(0), sup, x)
+    back = to_vmapped_params(to_looped_params(vm, 2), 2)
+    jax.tree.map(np.testing.assert_array_equal, back, vm)
+
+
+def test_converted_params_produce_identical_forward(problem):
+    sup, x = problem
+    vmapped_model = STMGCN(**KW)
+    looped_model = STMGCN(**KW, vmap_branches=False)
+
+    vm = vmapped_model.init(jax.random.key(0), sup, x)
+    want = vmapped_model.apply(vm, sup, x)
+    got = looped_model.apply(to_looped_params(vm, 2), sup, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+    lp = looped_model.init(jax.random.key(1), sup, x)
+    want2 = looped_model.apply(lp, sup, x)
+    got2 = vmapped_model.apply(to_vmapped_params(lp, 2), sup, x)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), rtol=1e-6)
+
+
+def test_wrong_layout_raises(problem):
+    sup, x = problem
+    vm = STMGCN(**KW).init(jax.random.key(0), sup, x)
+    lp = STMGCN(**KW, vmap_branches=False).init(jax.random.key(0), sup, x)
+    with pytest.raises(ValueError, match="vmapped-layout"):
+        to_looped_params(lp, 2)
+    with pytest.raises(ValueError, match="looped-layout"):
+        to_vmapped_params(vm, 2)
+    with pytest.raises(ValueError, match="branch axis"):
+        to_looped_params(vm, 3)

@@ -42,6 +42,9 @@ BATCH = int(os.environ.get("STMGCN_BENCH_BATCH", 64))
 DTYPE = os.environ.get("STMGCN_BENCH_DTYPE", "both")  # float32 | bfloat16 | both
 WARMUP = int(os.environ.get("STMGCN_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 30))
+# LSTM scan scheduling levers (numerically identical; see ops/lstm.py):
+LSTM_UNROLL = int(os.environ.get("STMGCN_BENCH_LSTM_UNROLL", 1))
+LSTM_FUSED = os.environ.get("STMGCN_BENCH_LSTM_FUSED", "0") == "1"
 LSTM_HIDDEN, LSTM_LAYERS, GCN_HIDDEN, M_GRAPHS, K_SUPPORTS = 64, 3, 64, 3, 3
 
 
@@ -120,6 +123,8 @@ def _measure(dtype: str, warmup: int, iters: int) -> dict:
         lstm_hidden_dim=LSTM_HIDDEN,
         lstm_num_layers=LSTM_LAYERS,
         gcn_hidden_dim=GCN_HIDDEN,
+        lstm_unroll=LSTM_UNROLL,
+        lstm_fused_scan=LSTM_FUSED,
         dtype=jnp.bfloat16 if dtype == "bfloat16" else None,
     )
     fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--cheb-k", type=int, default=None, help="max polynomial order K")
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default=None)
+    p.add_argument("--lstm-unroll", type=int, default=None,
+                   help="lax.scan unroll factor for the LSTM recurrence")
+    p.add_argument("--lstm-fused", action="store_true", default=None,
+                   help="run all LSTM layers inside one scan over time")
     p.add_argument("--sparse", action="store_true", default=None,
                    help="use the Pallas block-CSR SpMM path for graph convs")
     p.add_argument("--seed", type=int, default=None)
@@ -151,6 +155,10 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.model.dtype = args.dtype
     if args.sparse:
         cfg.model.sparse = True
+    if args.lstm_unroll is not None:
+        cfg.model.lstm_unroll = args.lstm_unroll
+    if args.lstm_fused:
+        cfg.model.lstm_fused_scan = True
     if args.region_strategy is not None:
         cfg.mesh.region_strategy = args.region_strategy
     if args.halo is not None:

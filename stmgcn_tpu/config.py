@@ -86,6 +86,10 @@ class ModelConfig:
     #: sparse graphs); branches loop instead of vmapping
     sparse: bool = False
     remat: bool = False
+    #: LSTM scan scheduling (numerically identical, XLA-level levers):
+    #: unroll factor for the time scan, and single-scan-all-layers fusion
+    lstm_unroll: int = 1
+    lstm_fused_scan: bool = False
     dtype: str = "float32"
 
     @property

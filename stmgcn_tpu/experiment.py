@@ -258,6 +258,8 @@ def build_model(
         n_real_nodes=n_real_nodes,
         vmap_branches=not _strategy_active(cfg),
         remat=m.remat,
+        lstm_unroll=m.lstm_unroll,
+        lstm_fused_scan=m.lstm_fused_scan,
         dtype=m.compute_dtype if m.dtype != "float32" else None,
     )
 

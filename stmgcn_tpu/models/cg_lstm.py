@@ -108,6 +108,8 @@ class CGLSTM(nn.Module):
     shard_spec: Any = None
     n_real_nodes: Optional[int] = None
     remat: bool = False
+    lstm_unroll: int = 1
+    lstm_fused_scan: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -134,6 +136,8 @@ class CGLSTM(nn.Module):
             hidden_dim=self.lstm_hidden_dim,
             num_layers=self.lstm_num_layers,
             remat=self.remat,
+            unroll=self.lstm_unroll,
+            fused_scan=self.lstm_fused_scan,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="lstm",

@@ -44,6 +44,8 @@ class Branch(nn.Module):
     shard_spec: Any = None
     n_real_nodes: Optional[int] = None
     remat: bool = False
+    lstm_unroll: int = 1
+    lstm_fused_scan: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -61,6 +63,8 @@ class Branch(nn.Module):
             shard_spec=self.shard_spec,
             n_real_nodes=self.n_real_nodes,
             remat=self.remat,
+            lstm_unroll=self.lstm_unroll,
+            lstm_fused_scan=self.lstm_fused_scan,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="cg_lstm",
@@ -117,6 +121,10 @@ class STMGCN(nn.Module):
     n_real_nodes: Optional[int] = None
     vmap_branches: bool = True
     remat: bool = False
+    #: lax.scan unroll factor / single-scan-all-layers for the shared LSTM
+    #: (pure XLA scheduling levers; numerically identical either way)
+    lstm_unroll: int = 1
+    lstm_fused_scan: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -147,6 +155,8 @@ class STMGCN(nn.Module):
             shard_spec=self.shard_spec if mode in ("banded", "sparse") else None,
             n_real_nodes=self.n_real_nodes,
             remat=self.remat,
+            lstm_unroll=self.lstm_unroll,
+            lstm_fused_scan=self.lstm_fused_scan,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )

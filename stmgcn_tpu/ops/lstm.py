@@ -10,6 +10,15 @@ translated:
   the reference config, SURVEY.md §3.2), leaving only the ``h @ Wh``
   recurrent matmul inside the scan;
 - the time loop is a ``lax.scan`` (compiler-friendly, no Python unrolling);
+- ``unroll > 1`` asks the scan to unroll that many steps per iteration —
+  XLA can then fuse the elementwise gate math across consecutive steps
+  (the recurrent matmul chain stays serial either way);
+- ``fused_scan=True`` runs ALL layers inside ONE scan over time (the
+  shape cuDNN's fused kernel takes): intermediate layers' ``(B, T, H)``
+  hidden sequences are never materialized to HBM — only the top layer's
+  output sequence is — at the cost of moving layers 1+'s input
+  projections inside the step. Numerically identical to the layered path
+  (same parameters, same math; equality-tested);
 - ``remat=True`` wraps the scan body in ``jax.checkpoint`` so long-horizon
   configs (BASELINE config 5, 24-step) trade recompute for activation
   memory.
@@ -53,8 +62,31 @@ class StackedLSTM(nn.Module):
     hidden_dim: int
     num_layers: int = 1
     remat: bool = False
+    #: scan steps unrolled per iteration (1 = plain scan)
+    unroll: int = 1
+    #: run all layers inside one scan over time (see module docstring)
+    fused_scan: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
+
+    def _layer_params(self, layer: int, in_dim: int):
+        h_dim = self.hidden_dim
+        scale = 1.0 / math.sqrt(h_dim)
+        wx = self.param(
+            f"wx_{layer}", _uniform_init(scale), (in_dim, 4 * h_dim), self.param_dtype
+        )
+        wh = self.param(
+            f"wh_{layer}", _uniform_init(scale), (h_dim, 4 * h_dim), self.param_dtype
+        )
+        b = self.param(f"b_{layer}", _uniform_init(scale), (4 * h_dim,), self.param_dtype)
+        return wx, wh, b
+
+    @staticmethod
+    def _cell(gates, c):
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, c
 
     @nn.compact
     def __call__(
@@ -62,20 +94,14 @@ class StackedLSTM(nn.Module):
         x: jnp.ndarray,
         initial_states: Optional[list] = None,
     ) -> tuple[jnp.ndarray, list]:
+        if self.fused_scan:
+            return self._fused(x, initial_states)
         batch = x.shape[0]
         h_dim = self.hidden_dim
-        scale = 1.0 / math.sqrt(h_dim)
         final_states = []
         inputs = x
         for layer in range(self.num_layers):
-            in_dim = inputs.shape[-1]
-            wx = self.param(
-                f"wx_{layer}", _uniform_init(scale), (in_dim, 4 * h_dim), self.param_dtype
-            )
-            wh = self.param(
-                f"wh_{layer}", _uniform_init(scale), (h_dim, 4 * h_dim), self.param_dtype
-            )
-            b = self.param(f"b_{layer}", _uniform_init(scale), (4 * h_dim,), self.param_dtype)
+            wx, wh, b = self._layer_params(layer, inputs.shape[-1])
             inputs, wx, wh, b = nn.dtypes.promote_dtype(inputs, wx, wh, b, dtype=self.dtype)
 
             # Hoisted input projection: one (B, T, 4H) matmul outside the scan.
@@ -89,20 +115,62 @@ class StackedLSTM(nn.Module):
 
             def step(carry, xt, wh=wh):
                 h, c = carry
-                gates = xt + h @ wh
-                i, f, g, o = jnp.split(gates, 4, axis=-1)
-                i = jax.nn.sigmoid(i)
-                f = jax.nn.sigmoid(f)
-                g = jnp.tanh(g)
-                o = jax.nn.sigmoid(o)
-                c = f * c + i * g
-                h = o * jnp.tanh(c)
+                h, c = self._cell(xt + h @ wh, c)
                 return (h, c), h
 
             if self.remat:
                 step = jax.checkpoint(step)
 
-            (h_t, c_t), hs = jax.lax.scan(step, (h0, c0), x_proj.swapaxes(0, 1))
+            (h_t, c_t), hs = jax.lax.scan(
+                step, (h0, c0), x_proj.swapaxes(0, 1), unroll=self.unroll
+            )
             inputs = hs.swapaxes(0, 1)  # (B, T, H)
             final_states.append((h_t, c_t))
         return inputs, final_states
+
+    def _fused(self, x: jnp.ndarray, initial_states: Optional[list]):
+        """All layers in one scan; only the top layer's sequence is kept."""
+        batch = x.shape[0]
+        h_dim = self.hidden_dim
+        params = []
+        in_dim = x.shape[-1]
+        for layer in range(self.num_layers):
+            params.append(self._layer_params(layer, in_dim))
+            in_dim = h_dim
+        x, *flat = nn.dtypes.promote_dtype(
+            x, *(p for lp in params for p in lp), dtype=self.dtype
+        )
+        params = [tuple(flat[3 * i : 3 * i + 3]) for i in range(self.num_layers)]
+
+        # Layer 0's input projection is still hoisted; deeper layers consume
+        # the previous layer's fresh h inside the step.
+        wx0, _, b0 = params[0]
+        x_proj0 = x @ wx0 + b0
+
+        if initial_states is not None:
+            states = tuple(tuple(s) for s in initial_states)
+        else:
+            zero = jnp.zeros((batch, h_dim), x_proj0.dtype)
+            states = tuple((zero, zero) for _ in range(self.num_layers))
+
+        def step(carry, xt0):
+            new_states = []
+            inp = None
+            for layer, (h, c) in enumerate(carry):
+                if layer == 0:
+                    gates = xt0 + h @ params[0][1]
+                else:
+                    wx, wh, b = params[layer]
+                    gates = inp @ wx + b + h @ wh
+                h, c = self._cell(gates, c)
+                new_states.append((h, c))
+                inp = h
+            return tuple(new_states), inp  # top layer's h
+
+        if self.remat:
+            step = jax.checkpoint(step)
+
+        final, hs_top = jax.lax.scan(
+            step, states, x_proj0.swapaxes(0, 1), unroll=self.unroll
+        )
+        return hs_top.swapaxes(0, 1), [tuple(s) for s in final]

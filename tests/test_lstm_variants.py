@@ -1,0 +1,94 @@
+"""LSTM scan-scheduling variants are numerically identical to the default.
+
+``unroll`` and ``fused_scan`` are pure XLA scheduling levers (the bench
+compares their step time on hardware); here the contract is equality with
+the layered scan on the SAME parameters, including gradients and remat.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.ops.lstm import StackedLSTM
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.normal(size=(16, 12, 3)).astype(np.float32))
+
+
+def _out(model, params, x):
+    outputs, finals = model.apply(params, x)
+    return outputs, finals
+
+
+@pytest.mark.parametrize("variant", [
+    dict(unroll=3), dict(unroll=12), dict(fused_scan=True),
+    dict(fused_scan=True, unroll=4), dict(fused_scan=True, remat=True),
+])
+def test_variant_matches_default(data, variant):
+    base = StackedLSTM(hidden_dim=8, num_layers=3)
+    params = base.init(jax.random.key(0), data)
+    want_out, want_fin = _out(base, params, data)
+
+    alt = StackedLSTM(hidden_dim=8, num_layers=3, **variant)
+    got_out, got_fin = _out(alt, params, data)  # identical param tree
+    np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out),
+                               rtol=1e-5, atol=1e-6)
+    for (gh, gc), (wh, wc) in zip(got_fin, want_fin):
+        np.testing.assert_allclose(np.asarray(gh), np.asarray(wh), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(wc), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_gradients_match_default(data):
+    base = StackedLSTM(hidden_dim=8, num_layers=3)
+    fused = StackedLSTM(hidden_dim=8, num_layers=3, fused_scan=True)
+    params = base.init(jax.random.key(1), data)
+
+    def loss(model, p):
+        out, _ = model.apply(p, data)
+        return jnp.mean(out[:, -1, :] ** 2)
+
+    g_base = jax.grad(lambda p: loss(base, p))(params)
+    g_fused = jax.grad(lambda p: loss(fused, p))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        g_fused, g_base,
+    )
+
+
+def test_fused_respects_initial_states(data):
+    rng = np.random.default_rng(2)
+    states = [
+        (jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32)),
+         jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32)))
+        for _ in range(2)
+    ]
+    base = StackedLSTM(hidden_dim=8, num_layers=2)
+    fused = StackedLSTM(hidden_dim=8, num_layers=2, fused_scan=True)
+    params = base.init(jax.random.key(3), data)
+    want, _ = base.apply(params, data, initial_states=states)
+    got, _ = fused.apply(params, data, initial_states=states)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_flagship_with_fused_lstm_matches(data):
+    from stmgcn_tpu.models import STMGCN
+
+    rng = np.random.default_rng(4)
+    sup = jnp.asarray((rng.normal(size=(2, 3, 16, 16)) * 0.2).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(4, 5, 16, 1)).astype(np.float32))
+    kw = dict(m_graphs=2, n_supports=3, seq_len=5, input_dim=1,
+              lstm_hidden_dim=8, lstm_num_layers=2, gcn_hidden_dim=8)
+    base = STMGCN(**kw)
+    fast = STMGCN(**kw, lstm_fused_scan=True, lstm_unroll=5)
+    params = base.init(jax.random.key(0), sup, x)
+    np.testing.assert_allclose(
+        np.asarray(fast.apply(params, sup, x)),
+        np.asarray(base.apply(params, sup, x)),
+        rtol=1e-5, atol=1e-6,
+    )

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Sweep bench variants on the current backend; print a table + JSON lines.
+
+Runs the flagship training step at the canonical operating point across
+the performance levers that need on-hardware numbers:
+
+- dtype: float32 vs bfloat16
+- LSTM scan schedule: layered / unroll=T / fused / fused+unroll
+  (numerically identical — equality pinned in tests/test_lstm_variants.py)
+
+Each variant runs in a fresh subprocess (one backend, one compile cache
+namespace, no cross-variant donation hazards) through ``bench.py`` with
+its env knobs, so this harness inherits bench's fail-open behavior. Use
+``--tiny`` to validate the sweep logic on a slow host.
+
+Usage::
+
+    python benchmarks/variants.py            # canonical shapes
+    python benchmarks/variants.py --tiny     # logic check (small, CPU ok)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+VARIANTS = [
+    # (label, extra env)
+    ("layered", {}),
+    ("unroll=T", {"STMGCN_BENCH_LSTM_UNROLL": "12"}),
+    ("fused", {"STMGCN_BENCH_LSTM_FUSED": "1"}),
+    ("fused+unroll", {"STMGCN_BENCH_LSTM_FUSED": "1", "STMGCN_BENCH_LSTM_UNROLL": "4"}),
+]
+
+
+def run_variant(label: str, env_extra: dict, tiny: bool) -> dict:
+    env = dict(os.environ)
+    env.update(env_extra)
+    if tiny:
+        env.update(
+            STMGCN_BENCH_ROWS="4",
+            STMGCN_BENCH_BATCH="8",
+            STMGCN_BENCH_WARMUP="1",
+            STMGCN_BENCH_ITERS="3",
+            STMGCN_BENCH_PLATFORM="cpu",
+        )
+    bench = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "bench.py")
+    out = subprocess.run(
+        [sys.executable, bench], env=env, capture_output=True, text=True
+    )
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
+    try:
+        record = json.loads(line)
+    except json.JSONDecodeError:
+        record = {"error": f"unparsable bench output: {line[-200:]}"}
+    record["variant"] = label
+    return record
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="small shapes, CPU pinned")
+    args = ap.parse_args()
+
+    records = []
+    for label, env_extra in VARIANTS:
+        rec = run_variant(label, env_extra, args.tiny)
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    def fmt(v):
+        return "-" if v is None else (f"{v:.4f}" if isinstance(v, float) and v < 1 else f"{v:,.1f}")
+
+    print(f"\n{'variant':<14} {'fp32 r-ts/s':>14} {'fp32 ms':>9} {'fp32 mfu':>9} "
+          f"{'bf16 r-ts/s':>14} {'bf16 ms':>9} {'bf16 mfu':>9}")
+    for rec in records:
+        bf = rec.get("bf16") or {}
+        print(f"{rec['variant']:<14} {fmt(rec.get('value')):>14} "
+              f"{fmt(rec.get('step_ms')):>9} {fmt(rec.get('mfu')):>9} "
+              f"{fmt(bf.get('value')):>14} {fmt(bf.get('step_ms')):>9} "
+              f"{fmt(bf.get('mfu')):>9}")
+    if any("error" in r for r in records):
+        print("\nnote: some variants recorded errors (see JSON lines above)")
+
+
+if __name__ == "__main__":
+    main()

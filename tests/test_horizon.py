@@ -113,6 +113,33 @@ class TestHorizonOnMesh:
         )
         np.testing.assert_allclose(float(loss_mesh), float(loss_single), rtol=1e-5)
 
+    def test_longhorizon_trains_on_banded_mesh_with_padding(self, tmp_path):
+        """Seq2seq (4-D targets) x banded routing x node padding compose:
+        the longhorizon preset on a (dp=4, region=2) mesh at N=25 -> 26."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from stmgcn_tpu.config import preset
+        from stmgcn_tpu.experiment import build_trainer
+
+        cfg = preset("longhorizon")
+        cfg.data.rows = 5  # N=25: pads to 26 over region=2 (13-node shards)
+        cfg.data.serial_len = 6
+        cfg.data.horizon = 4
+        cfg.data.n_timesteps = 24 * 7 * 2 + 48
+        cfg.train.epochs = 1
+        cfg.train.batch_size = 16
+        cfg.train.out_dir = str(tmp_path)
+        cfg.mesh.dp, cfg.mesh.region = 4, 2
+        cfg.mesh.region_strategy = "auto"
+        cfg.mesh.halo = 10  # grid bandwidth 2*5=10 <= shard 13 -> banded
+        trainer = build_trainer(cfg, verbose=False)
+        assert trainer.node_pad == 1
+        assert "banded" in trainer.model.branch_modes()
+        hist = trainer.train()
+        assert np.isfinite(hist["train"]).all()
+        res = trainer.test(modes=("test",))
+        assert np.isfinite(res["test"]["rmse"])
+
 
 class TestLongHorizonPreset:
     def test_end_to_end(self, tmp_path):

@@ -16,13 +16,13 @@ with the same control semantics, restructured for JAX:
 - per-epoch JSONL records land in ``<out_dir>/history.jsonl`` in addition
   to stdout prints (SURVEY.md §5.e).
 
-Multi-host note: only the lead process *writes* ``out_dir``, but
-``restore()`` and ``test()`` *read* checkpoints on **every** process —
-``out_dir`` must therefore live on a filesystem shared across hosts (GCS
-fuse, NFS). On pods with host-local disks, non-lead processes would fail
-to open the file (or silently read a stale copy); broadcasting restored
-state from process 0 instead is a possible future extension
-(``jax.experimental.multihost_utils``).
+Multi-host note: only the lead process touches ``out_dir`` — writes
+always, and in multi-process jobs reads too: ``restore()``/``test()``
+load the checkpoint on process 0 and **broadcast** the state (params,
+optimizer state, JSON metadata) to every other process via
+``jax.experimental.multihost_utils``, so ``out_dir`` may live on
+host-local disk. A shared filesystem is only needed if non-lead hosts
+should also see the files themselves.
 """
 
 from __future__ import annotations
@@ -368,14 +368,40 @@ class Trainer:
         self._log(f"Training ends at: {time.ctime()}")
         return history
 
+    def _load_state(self, path: str):
+        """Read a checkpoint — on the lead process only in multi-host jobs,
+        broadcasting the state to everyone else (module docstring)."""
+        if jax.process_count() == 1:
+            return load_checkpoint(path, self.params, self.opt_state)
+        import json as _json
+
+        from jax.experimental import multihost_utils
+
+        if self.is_lead:
+            meta, params, opt_state = load_checkpoint(path, self.params, self.opt_state)
+            blob = np.frombuffer(_json.dumps(meta).encode(), dtype=np.uint8)
+        else:
+            params, opt_state = self.params, self.opt_state
+            blob = np.zeros(0, np.uint8)
+        n = int(multihost_utils.broadcast_one_to_all(np.int64(blob.size)))
+        buf = np.zeros(n, np.uint8)
+        if self.is_lead:
+            buf[:] = blob
+        meta = _json.loads(bytes(np.asarray(
+            multihost_utils.broadcast_one_to_all(buf)
+        )).decode())
+        params = multihost_utils.broadcast_one_to_all(params)
+        opt_state = multihost_utils.broadcast_one_to_all(opt_state)
+        return meta, params, opt_state
+
     def restore(self, path: Optional[str] = None) -> dict:
         """Load a checkpoint (default: latest) into the live trainer state.
 
-        Reads on every process — multi-host jobs need ``out_dir`` on a
-        shared filesystem (see the module docstring).
+        Multi-host jobs read on the lead and broadcast (see the module
+        docstring), so ``out_dir`` may be host-local.
         """
         path = path or self.latest_path
-        meta, params, opt_state = load_checkpoint(path, self.params, self.opt_state)
+        meta, params, opt_state = self._load_state(path)
         self.params = self.placement.put(params, "state")
         self.opt_state = self.placement.put(opt_state, "state")
         self.epoch = meta["epoch"]
@@ -394,7 +420,7 @@ class Trainer:
         params = self.params
         if checkpoint is not None:
             path = self.best_path if checkpoint == "best" else checkpoint
-            _, params, _ = load_checkpoint(path, self.params, self.opt_state)
+            _, params, _ = self._load_state(path)
             params = self.placement.put(params, "state")
         self._log(f"Testing starts at: {time.ctime()}")
         results = {}

@@ -37,6 +37,33 @@ def test_kernel_family_trains_end_to_end(tmp_path, kernel, K, n_supports):
     assert np.isfinite(hist["train"][0])
 
 
+@pytest.mark.parametrize("kernel,K", [("localpool", 1), ("random_walk_diffusion", 1)])
+@pytest.mark.parametrize("mode", ["sparse", "banded-mesh"])
+def test_kernel_family_composes_with_modes(tmp_path, kernel, K, mode):
+    """Non-default kernel families run through the sparse block-CSR path
+    and the banded mesh routing, not just the dense chebyshev default."""
+    import jax
+
+    cfg = tiny(preset("smoke"))
+    cfg.model.kernel_type = kernel
+    cfg.model.K = K
+    cfg.model.m_graphs = 1  # smoke preset: neighbor grid only (banded-able)
+    cfg.train.out_dir = str(tmp_path)
+    if mode == "sparse":
+        cfg.model.sparse = True
+    else:
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        cfg.mesh.dp, cfg.mesh.region = 4, 2
+        cfg.mesh.region_strategy = "auto"
+        cfg.mesh.halo = 8  # rook-grid bandwidth: K hops x cols=4
+    trainer = build_trainer(cfg, verbose=False)
+    if mode == "banded-mesh":
+        assert trainer.model.branch_modes() == ("banded",)
+    hist = trainer.train()
+    assert np.isfinite(hist["train"][0])
+
+
 def test_forward_only_diffusion_supports():
     cfg = tiny(preset("smoke"))
     cfg.model.kernel_type = "random_walk_diffusion"

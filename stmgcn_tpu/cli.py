@@ -25,6 +25,13 @@ from stmgcn_tpu.config import PRESETS, preset
 __all__ = ["build_parser", "main"]
 
 
+def _positive_int(value: str) -> int:
+    n = int(value)
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+    return n
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="stmgcn",
@@ -81,6 +88,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--virtual-devices", type=int, default=None, metavar="N",
                    help="emulate N devices on CPU (for mesh dry-runs; implies "
                         "--platform cpu)")
+    p.add_argument("--branch-parallel", type=_positive_int, default=None,
+                   metavar="B",
+                   help="shard the M graph branches over a 'branch' mesh "
+                        "axis of extent B (dense vmapped mode only)")
     p.add_argument("--region-strategy", choices=("gspmd", "banded", "auto"),
                    default=None,
                    help="region-sharded conv plan: XLA's automatic (gspmd), "
@@ -159,6 +170,8 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.model.lstm_unroll = args.lstm_unroll
     if args.lstm_fused:
         cfg.model.lstm_fused_scan = True
+    if args.branch_parallel is not None:
+        cfg.mesh.branch = args.branch_parallel
     if args.region_strategy is not None:
         cfg.mesh.region_strategy = args.region_strategy
     if args.halo is not None:

@@ -130,6 +130,11 @@ class MeshConfig:
 
     dp: int = 1
     region: int = 1
+    #: graph-branch model parallelism: shard the M stacked branches (and
+    #: their params/supports) over this axis; the sum fusion becomes one
+    #: psum. Requires dense vmapped branches (no sparse / region_strategy)
+    #: and m_graphs % branch == 0.
+    branch: int = 1
     #: how region-sharded graph convs communicate:
     #: - "gspmd": dense supports, XLA's automatic plan (all-gathers the
     #:   node axis of the signal per conv)
@@ -142,9 +147,18 @@ class MeshConfig:
     #: capped by the auto-routing threshold n_local // 2
     halo: Optional[int] = None
 
+    def __post_init__(self):
+        # extent 0 would silently zero n_devices and skip the mesh entirely
+        # (a run the user asked to shard would train single-device)
+        if min(self.dp, self.region, self.branch) < 1:
+            raise ValueError(
+                f"mesh extents must be >= 1, got dp={self.dp} "
+                f"region={self.region} branch={self.branch}"
+            )
+
     @property
     def n_devices(self) -> int:
-        return self.dp * self.region
+        return self.dp * self.region * self.branch
 
 
 @dataclasses.dataclass

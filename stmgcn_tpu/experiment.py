@@ -151,6 +151,13 @@ def _strategy_active(cfg: ExperimentConfig) -> bool:
         raise ValueError(
             f"mesh.region_strategy must be gspmd|banded|auto, got {s!r}"
         )
+    if cfg.mesh.branch > 1 and (cfg.model.sparse or (s != "gspmd" and cfg.mesh.region > 1)):
+        # branch parallelism shards the *vmapped stacked* branch axis; the
+        # loop layouts (sparse / explicit region plans) have no such axis
+        raise ValueError(
+            "mesh.branch > 1 requires dense vmapped branches — it cannot "
+            "combine with model.sparse or an active region_strategy"
+        )
     return s != "gspmd" and cfg.mesh.region > 1 and not cfg.model.sparse
 
 
@@ -170,6 +177,7 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
       supports as :class:`~stmgcn_tpu.parallel.sparse.ShardedBlockSparse`
       row strips over the region axis.
     """
+    _strategy_active(cfg)  # validates strategy / branch-axis combinations
     if not dataset.shared_graphs and (
         (cfg.model.sparse and cfg.mesh.n_devices > 1) or _strategy_active(cfg)
     ):
@@ -302,7 +310,9 @@ def build_trainer(
     )
     if placement is not None and hasattr(placement, "check_divisibility"):
         placement.check_divisibility(
-            cfg.train.batch_size, n_pad if n_pad is not None else dataset.n_nodes
+            cfg.train.batch_size,
+            n_pad if n_pad is not None else dataset.n_nodes,
+            m_graphs=cfg.model.m_graphs,
         )
     t = cfg.train
     return Trainer(

@@ -1,11 +1,21 @@
 """Device mesh construction.
 
-A 2-D logical mesh ``(dp, region)``: the ``dp`` axis carries data
-parallelism (batch sharding + gradient all-reduce), the ``region`` axis
-carries graph-node parallelism for large-N configs (BASELINE config 3's
-50x50 grid). On real hardware the mesh should be laid out so ``region``
-(the high-traffic axis: node all-gathers every layer) maps to the faster
-ICI links; ``jax.experimental.mesh_utils`` does this when available.
+A logical mesh of up to three axes ``(dp, region, branch)``:
+
+- ``dp`` — data parallelism (batch sharding + gradient all-reduce);
+- ``region`` — graph-node model parallelism for large-N configs
+  (BASELINE config 3's 50x50 grid);
+- ``branch`` — graph-branch model parallelism: the M graph views are
+  independent until the sum fusion (``STMGCN.py:112-116`` in the
+  reference runs them *sequentially*), so their stacked parameters and
+  supports shard over this axis and the fusion becomes one ``psum`` —
+  the expert-parallel analogue for this model family.
+
+On real hardware the mesh should be laid out so the high-traffic axis
+(``region``: node all-gathers every conv) maps to the faster ICI links;
+``jax.experimental.mesh_utils`` does this when available. The ``branch``
+axis is omitted from the mesh when its extent is 1, so 2-D callers are
+unaffected.
 """
 
 from __future__ import annotations
@@ -37,32 +47,43 @@ def init_distributed(
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 
-def build_mesh(dp: int = 1, region: int = 1, devices: Optional[Sequence] = None) -> Mesh:
-    """Build a ``(dp, region)`` mesh from the first ``dp*region`` devices."""
+def build_mesh(
+    dp: int = 1,
+    region: int = 1,
+    branch: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``(dp, region[, branch])`` mesh from the first devices.
+
+    The ``branch`` axis only appears in the mesh when its extent is > 1.
+    """
     if devices is None:
         devices = jax.devices()
-    need = dp * region
-    if need < 1:
-        raise ValueError(f"mesh extents must be positive, got dp={dp}, region={region}")
+    extents = {"dp": dp, "region": region, "branch": branch}
+    if any(e < 1 for e in extents.values()):
+        raise ValueError(f"mesh extents must be positive, got {extents}")
+    shape = (dp, region) if branch == 1 else (dp, region, branch)
+    names = ("dp", "region") if branch == 1 else ("dp", "region", "branch")
+    need = dp * region * branch
     if len(devices) < need:
         raise ValueError(
-            f"mesh needs {need} devices (dp={dp} x region={region}) but only "
-            f"{len(devices)} are visible"
+            f"mesh needs {need} devices ({' x '.join(f'{n}={e}' for n, e in zip(names, shape))}) "
+            f"but only {len(devices)} are visible"
         )
     if need > 1:
         try:  # physical-topology-aware layout on real TPU slices
             from jax.experimental import mesh_utils
 
-            arr = mesh_utils.create_device_mesh((dp, region), devices=devices[:need])
+            arr = mesh_utils.create_device_mesh(shape, devices=devices[:need])
         except Exception:
-            arr = np.asarray(devices[:need]).reshape(dp, region)
+            arr = np.asarray(devices[:need]).reshape(shape)
     else:
-        arr = np.asarray(devices[:need]).reshape(dp, region)
-    return Mesh(arr, axis_names=("dp", "region"))
+        arr = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(arr, axis_names=names)
 
 
 def mesh_from_config(mesh_cfg, devices: Optional[Sequence] = None) -> Optional[Mesh]:
     """``MeshConfig -> Mesh``, or ``None`` for the single-device 1x1 case."""
     if mesh_cfg.n_devices <= 1:
         return None
-    return build_mesh(mesh_cfg.dp, mesh_cfg.region, devices=devices)
+    return build_mesh(mesh_cfg.dp, mesh_cfg.region, mesh_cfg.branch, devices=devices)

@@ -1,4 +1,4 @@
-"""Array placement rules over a ``(dp, region)`` mesh.
+"""Array placement rules over a ``(dp, region[, branch])`` mesh.
 
 One object answers "where does this array live": model/optimizer state is
 replicated, batches are split over ``dp``, the graph-node axis over
@@ -11,13 +11,18 @@ the reference never had (SURVEY.md §5.h).
 Array-kind conventions (shapes as in the model):
 
 - ``supports`` ``(M, K, N, N)`` — rows (output nodes) sharded:
-  ``P(None, None, 'region', None)``
+  ``P(None, None, 'region', None)``; with a ``branch`` mesh axis the
+  graph axis shards too: ``P('branch', None, 'region', None)``
 - ``x`` ``(B, T, N, C)`` — ``P('dp', None, 'region', None)``
 - ``y`` ``(B, N, C)`` — ``P('dp', 'region', None)``; the seq2seq
   ``(B, H, N, C)`` form shards the node axis: ``P('dp', None, 'region',
   None)`` (the horizon axis is never sharded)
-- ``mask`` ``(B,)`` — ``P('dp')``
-- ``state`` (params / optimizer) — replicated ``P()``
+- ``mask`` ``(B,)`` — ``P('dp')``; node-padded ``(B, N)`` —
+  ``P('dp', 'region')``
+- ``state`` (params / optimizer) — replicated ``P()``; with a ``branch``
+  axis, leaves under the vmapped ``branches`` subtree shard their leading
+  ``(M, ...)`` axis over it (the fusion sum becomes a ``psum``) — branch
+  model parallelism, the expert-parallel analogue for this model family
 """
 
 from __future__ import annotations
@@ -69,12 +74,32 @@ class MeshPlacement:
             raise ValueError(f"unknown array kind {kind!r}; known: {sorted(self.SPECS)}")
         if kind == "supports":
             return self._put_supports(tree)
+        if kind == "state" and "branch" in self.mesh.shape:
+            return self._put_state_branched(tree)
         return jax.tree.map(
             lambda a: jax.device_put(
                 jnp.asarray(a), self.sharding(kind, jnp.ndim(a))
             ),
             tree,
         )
+
+    def _put_state_branched(self, tree):
+        """State placement with branch model parallelism: leaves under the
+        vmapped ``branches`` subtree shard their leading (M, ...) axis over
+        the ``branch`` mesh axis; everything else replicates."""
+        from jax.tree_util import DictKey, tree_map_with_path
+
+        def place(path, leaf):
+            in_branches = any(
+                isinstance(k, DictKey) and k.key == "branches" for k in path
+            )
+            leaf = jnp.asarray(leaf)
+            spec = (
+                P("branch", *([None] * (leaf.ndim - 1))) if in_branches else P()
+            )
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        return tree_map_with_path(place, tree)
 
     def _put_supports(self, supports):
         """Dense ``(M, K, N, N)`` stack, per-branch ``(K, N, N)`` arrays,
@@ -107,7 +132,11 @@ class MeshPlacement:
             )
         arr = jnp.asarray(supports)
         if arr.ndim == 4:  # (M, K, N, N): output-node rows sharded
-            spec = self.SPECS["supports"]
+            spec = (
+                P("branch", None, "region", None)
+                if "branch" in self.mesh.shape
+                else self.SPECS["supports"]
+            )
         elif arr.ndim == 3:  # per-branch (K, N, N)
             spec = P(None, "region", None)
         else:
@@ -116,10 +145,15 @@ class MeshPlacement:
             )
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
-    def check_divisibility(self, batch_size: int, n_nodes: int) -> None:
+    def check_divisibility(
+        self, batch_size: int, n_nodes: int, m_graphs: int | None = None
+    ) -> None:
         dp = self.mesh.shape["dp"]
         region = self.mesh.shape["region"]
         if batch_size % dp:
             raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
         if n_nodes % region:
             raise ValueError(f"n_nodes {n_nodes} not divisible by region={region}")
+        branch = self.mesh.shape.get("branch", 1)
+        if branch > 1 and m_graphs is not None and m_graphs % branch:
+            raise ValueError(f"m_graphs {m_graphs} not divisible by branch={branch}")

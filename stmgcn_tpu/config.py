@@ -120,6 +120,10 @@ class TrainConfig:
     #: batches placed on device ahead of the consuming step (0 disables);
     #: overlaps host->device copies with device compute
     prefetch: int = 1
+    #: write checkpoint files from a background worker (serialization —
+    #: the device->host snapshot — stays on the training thread; reads
+    #: flush pending writes first)
+    async_checkpoint: bool = True
     seed: int = 0
     out_dir: str = "output"
 

@@ -328,6 +328,7 @@ def build_trainer(
         patience=t.patience,
         top_k=t.top_k,
         prefetch=t.prefetch,
+        async_checkpoint=t.async_checkpoint,
         shuffle=t.shuffle,
         seed=t.seed,
         out_dir=t.out_dir,

@@ -22,25 +22,48 @@ from typing import Any, Optional
 
 from flax import serialization
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = [
+    "load_checkpoint",
+    "save_checkpoint",
+    "serialize_checkpoint",
+    "write_checkpoint_bytes",
+]
 
 _MAGIC = b"STMG1\n"
 
 
-def save_checkpoint(path: str, params: Any, opt_state: Any, meta: dict) -> None:
-    """Atomically write ``params``/``opt_state``/``meta`` to ``path``."""
+def serialize_checkpoint(params: Any, opt_state: Any, meta: dict) -> bytes:
+    """Snapshot state into one self-contained byte string.
+
+    This is the device→host boundary: ``to_bytes`` materializes every leaf
+    to host numpy, so the returned blob is immune to later in-place updates
+    / donation of the live training state — safe to hand to a background
+    writer thread.
+    """
     blobs = [
         json.dumps(meta).encode("utf-8"),
         serialization.to_bytes(params),
         serialization.to_bytes(opt_state),
     ]
+    out = [_MAGIC]
+    for blob in blobs:
+        out.append(struct.pack("<Q", len(blob)))
+        out.append(blob)
+    return b"".join(out)
+
+
+def write_checkpoint_bytes(path: str, data: bytes) -> None:
+    """Atomically write a serialized checkpoint (temp file + ``os.replace``
+    so a preemption mid-write never corrupts the previous checkpoint)."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
-        f.write(_MAGIC)
-        for blob in blobs:
-            f.write(struct.pack("<Q", len(blob)))
-            f.write(blob)
+        f.write(data)
     os.replace(tmp, path)
+
+
+def save_checkpoint(path: str, params: Any, opt_state: Any, meta: dict) -> None:
+    """Atomically write ``params``/``opt_state``/``meta`` to ``path``."""
+    write_checkpoint_bytes(path, serialize_checkpoint(params, opt_state, meta))
 
 
 def load_checkpoint(

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 import time
 from typing import Optional
 
@@ -38,7 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from stmgcn_tpu.data.pipeline import DemandDataset
-from stmgcn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+from stmgcn_tpu.train.checkpoint import (
+    load_checkpoint,
+    serialize_checkpoint,
+    write_checkpoint_bytes,
+)
 from stmgcn_tpu.train.metrics import regression_report
 from stmgcn_tpu.train.step import make_optimizer, make_step_fns
 
@@ -111,6 +114,7 @@ class Trainer:
         top_k: int = 1,
         prefetch: int = 1,
         node_pad: int = 0,
+        async_checkpoint: bool = True,
         placement=None,
         extra_meta: Optional[dict] = None,
         verbose: bool = True,
@@ -132,6 +136,13 @@ class Trainer:
         #: padded rows are isolated (zero supports), excluded from the gate
         #: pooling (model.n_real_nodes) and masked out of the loss/metrics
         self.node_pad = node_pad
+        #: serialize on the training thread (device->host snapshot), write
+        #: the file from a background worker — IO leaves the epoch's
+        #: critical path. Reads (restore/test) flush pending writes first.
+        self.async_checkpoint = async_checkpoint
+        self._write_queue = None
+        self._writer = None
+        self._writer_error: Optional[BaseException] = None
         self.verbose = verbose
         self.extra_meta = extra_meta or {}
         # device placement hook; stmgcn_tpu.parallel.MeshPlacement shards over
@@ -205,9 +216,64 @@ class Trainer:
         with open(os.path.join(self.out_dir, "history.jsonl"), "a") as f:
             f.write(json.dumps(record) + "\n")
 
-    def _save(self, path: str) -> None:
-        if self.is_lead:
-            save_checkpoint(path, self.params, self.opt_state, self._meta())
+    def _save(self, path: str) -> Optional[bytes]:
+        """Snapshot current state to ``path``; returns the serialized bytes
+        (lead process only) so equal-content snapshots reuse them."""
+        if not self.is_lead:
+            return None
+        data = serialize_checkpoint(self.params, self.opt_state, self._meta())
+        self._write(path, data)
+        return data
+
+    def _write(self, path: str, data: bytes) -> None:
+        if not self.async_checkpoint:
+            write_checkpoint_bytes(path, data)
+            return
+        import queue
+        import threading
+
+        if self._writer is None:
+            self._write_queue = queue.Queue()
+
+            def worker():
+                while True:
+                    job = self._write_queue.get()
+                    if job is None:
+                        return
+                    op, path, data = job
+                    try:
+                        if op == "write":
+                            write_checkpoint_bytes(path, data)
+                        else:  # "rm" — FIFO with writes, so a stale snapshot
+                            try:  # cannot resurrect after its removal
+                                os.remove(path)
+                            except OSError:
+                                pass
+                    except BaseException as e:  # surfaced on the next flush
+                        self._writer_error = e
+                    finally:
+                        self._write_queue.task_done()
+
+            self._writer = threading.Thread(target=worker, daemon=True)
+            self._writer.start()
+        self._write_queue.put(("write", path, data))
+
+    def _remove(self, path: str) -> None:
+        if self.async_checkpoint and self._write_queue is not None:
+            self._write_queue.put(("rm", path, None))
+            return
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def flush_checkpoints(self) -> None:
+        """Block until pending checkpoint writes land; re-raise failures."""
+        if self._write_queue is not None:
+            self._write_queue.join()
+        if self._writer_error is not None:
+            err, self._writer_error = self._writer_error, None
+            raise RuntimeError("background checkpoint write failed") from err
 
     def _meta(self) -> dict:
         meta = {
@@ -328,23 +394,21 @@ class Trainer:
                 )
                 self.best_val = val_loss
                 self.patience_left = self.patience
-                self._save(self.best_path)
+                data = self._save(self.best_path)
                 if self.top_k > 1 and self.is_lead:
                     # best-k retention (SURVEY.md §5.d): keep the k best
-                    # improvement snapshots alongside best/latest; best.ckpt
-                    # was just written with identical content, so copy it
+                    # improvement snapshots alongside best/latest; reuse the
+                    # bytes just serialized for best.ckpt (identical content,
+                    # and best.ckpt may still be in the async write queue)
                     path = os.path.join(self.out_dir, f"best_e{epoch}.ckpt")
-                    shutil.copyfile(self.best_path, path)
+                    self._write(path, data)
                     # rank by (loss, newest-wins-on-ties) to match the
                     # `val <= best` improvement rule
                     self._kept.append((val_loss, -epoch, path))
                     self._kept.sort()
                     while len(self._kept) > self.top_k:
                         _, _, stale = self._kept.pop()
-                        try:
-                            os.remove(stale)
-                        except OSError:
-                            pass
+                        self._remove(stale)
             else:
                 self.patience_left -= 1
                 self._log(
@@ -365,12 +429,14 @@ class Trainer:
             if self.patience_left == 0:
                 self._log(f"Early stopping at epoch {epoch}..")
                 break
+        self.flush_checkpoints()
         self._log(f"Training ends at: {time.ctime()}")
         return history
 
     def _load_state(self, path: str):
         """Read a checkpoint — on the lead process only in multi-host jobs,
         broadcasting the state to everyone else (module docstring)."""
+        self.flush_checkpoints()  # a pending async write may own this path
         if jax.process_count() == 1:
             return load_checkpoint(path, self.params, self.opt_state)
         import json as _json

@@ -151,6 +151,40 @@ class TestCheckpoint:
         out_b = model.apply(jax.tree.map(jnp.asarray, params_l), sup, x)
         np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=1e-6)
 
+    def test_async_writes_identical_files_and_surfaces_errors(self, tmp_path):
+        """Async checkpointing is a pure IO-scheduling change: byte-identical
+        files vs sync mode, and worker failures surface at flush."""
+        from stmgcn_tpu.config import preset
+        from stmgcn_tpu.experiment import build_trainer
+
+        loaded = {}
+        for label, flag in (("sync", False), ("async", True)):
+            cfg = preset("smoke")
+            cfg.data.n_timesteps = 24 * 7 * 2 + 48
+            cfg.train.epochs = 2
+            cfg.train.async_checkpoint = flag
+            cfg.train.out_dir = str(tmp_path / label)
+            trainer = build_trainer(cfg, verbose=False)
+            trainer.train()  # flushes pending writes before returning
+            loaded[label] = load_checkpoint(str(tmp_path / label / "best.ckpt"))
+        meta_s, params_s, opt_s = loaded["sync"]
+        meta_a, params_a, opt_a = loaded["async"]
+        # identical state; meta differs only by the flag inside the config
+        jax.tree.map(np.testing.assert_array_equal, params_a, params_s)
+        jax.tree.map(np.testing.assert_array_equal, opt_a, opt_s)
+        assert meta_a["epoch"] == meta_s["epoch"]
+        assert meta_a["best_val"] == meta_s["best_val"]
+
+        # a failing write is re-raised on flush, not swallowed
+        cfg = preset("smoke")
+        cfg.data.n_timesteps = 24 * 7 * 2 + 48
+        cfg.train.epochs = 1
+        cfg.train.out_dir = str(tmp_path / "err")
+        trainer = build_trainer(cfg, verbose=False)
+        trainer._write(str(tmp_path / "no_such_dir" / "x.ckpt"), b"data")
+        with pytest.raises(RuntimeError, match="background checkpoint"):
+            trainer.flush_checkpoints()
+
     def test_bad_magic_raises(self, tmp_path):
         path = tmp_path / "junk.ckpt"
         path.write_bytes(b"not a checkpoint")

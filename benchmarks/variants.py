@@ -5,13 +5,15 @@ Runs the flagship training step at the canonical operating point across
 the performance levers that need on-hardware numbers:
 
 - dtype: float32 vs bfloat16
-- LSTM scan schedule: layered / unroll=T / fused / fused+unroll
+- LSTM scan schedule: plain layered scan / unroll=T / fused / fused+unroll
   (numerically identical — equality pinned in tests/test_lstm_variants.py)
 
-Each variant runs in a fresh subprocess (one backend, one compile cache
-namespace, no cross-variant donation hazards) through ``bench.py`` with
-its env knobs, so this harness inherits bench's fail-open behavior. Use
-``--tiny`` to validate the sweep logic on a slow host.
+``bench.py`` itself measures the {plain, tuned} x {fp32, bf16} grid in one
+run (its ``variants`` table); this harness adds the intermediate schedules
+(unroll-only, fused-only) as separate subprocess runs through bench's env
+knobs — one backend and compile-cache namespace per run, inheriting
+bench's fail-open behavior. Use ``--tiny`` to validate the sweep logic on
+a slow host.
 
 Usage::
 
@@ -27,16 +29,15 @@ import os
 import subprocess
 import sys
 
-VARIANTS = [
-    # (label, extra env)
-    ("layered", {}),
-    ("unroll=T", {"STMGCN_BENCH_LSTM_UNROLL": "12"}),
-    ("fused", {"STMGCN_BENCH_LSTM_FUSED": "1"}),
-    ("fused+unroll", {"STMGCN_BENCH_LSTM_FUSED": "1", "STMGCN_BENCH_LSTM_UNROLL": "4"}),
+#: extra single-schedule runs beyond bench's built-in plain/tuned pair;
+#: both env vars are always set explicitly so the pair means exactly this
+EXTRA_VARIANTS = [
+    ("unroll=T", {"STMGCN_BENCH_LSTM_UNROLL": "0", "STMGCN_BENCH_LSTM_FUSED": "0"}),
+    ("fused", {"STMGCN_BENCH_LSTM_UNROLL": "1", "STMGCN_BENCH_LSTM_FUSED": "1"}),
 ]
 
 
-def run_variant(label: str, env_extra: dict, tiny: bool) -> dict:
+def run_bench(env_extra: dict, tiny: bool) -> dict:
     env = dict(os.environ)
     env.update(env_extra)
     if tiny:
@@ -53,11 +54,9 @@ def run_variant(label: str, env_extra: dict, tiny: bool) -> dict:
     )
     line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
     try:
-        record = json.loads(line)
+        return json.loads(line)
     except json.JSONDecodeError:
-        record = {"error": f"unparsable bench output: {line[-200:]}"}
-    record["variant"] = label
-    return record
+        return {"error": f"unparsable bench output: {line[-200:]}"}
 
 
 def main() -> None:
@@ -66,24 +65,29 @@ def main() -> None:
     args = ap.parse_args()
 
     records = []
-    for label, env_extra in VARIANTS:
-        rec = run_variant(label, env_extra, args.tiny)
+    for label, env_extra in [("plain+tuned", {})] + EXTRA_VARIANTS:
+        rec = run_bench(env_extra, args.tiny)
+        rec["sweep_variant"] = label
         records.append(rec)
         print(json.dumps(rec), flush=True)
+
+    # flatten every record's per-leg table into (schedule, dtype, leg) rows
+    rows = []
+    for rec in records:
+        for key, leg in (rec.get("variants") or {}).items():
+            dtype, sched = key.split("/", 1)
+            label = rec["sweep_variant"] if sched == "custom" else sched
+            rows.append((label, dtype, leg))
 
     def fmt(v):
         return "-" if v is None else (f"{v:.4f}" if isinstance(v, float) and v < 1 else f"{v:,.1f}")
 
-    print(f"\n{'variant':<14} {'fp32 r-ts/s':>14} {'fp32 ms':>9} {'fp32 mfu':>9} "
-          f"{'bf16 r-ts/s':>14} {'bf16 ms':>9} {'bf16 mfu':>9}")
-    for rec in records:
-        bf = rec.get("bf16") or {}
-        print(f"{rec['variant']:<14} {fmt(rec.get('value')):>14} "
-              f"{fmt(rec.get('step_ms')):>9} {fmt(rec.get('mfu')):>9} "
-              f"{fmt(bf.get('value')):>14} {fmt(bf.get('step_ms')):>9} "
-              f"{fmt(bf.get('mfu')):>9}")
+    print(f"\n{'schedule':<14} {'dtype':<9} {'r-ts/s':>14} {'step ms':>9} {'mfu':>9}")
+    for label, dtype, leg in rows:
+        print(f"{label:<14} {dtype:<9} {fmt(leg.get('value')):>14} "
+              f"{fmt(leg.get('step_ms')):>9} {fmt(leg.get('mfu')):>9}")
     if any("error" in r for r in records):
-        print("\nnote: some variants recorded errors (see JSON lines above)")
+        print("\nnote: some runs recorded errors (see JSON lines above)")
 
 
 if __name__ == "__main__":

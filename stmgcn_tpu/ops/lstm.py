@@ -62,7 +62,10 @@ class StackedLSTM(nn.Module):
     hidden_dim: int
     num_layers: int = 1
     remat: bool = False
-    #: scan steps unrolled per iteration (1 = plain scan)
+    #: scan steps unrolled per iteration (1 = plain scan; 0 = unroll the
+    #: whole sequence — the fastest schedule measured on TPU v5e at the
+    #: bench operating point, where loop bookkeeping dominates the tiny
+    #: per-step recurrent matmul)
     unroll: int = 1
     #: run all layers inside one scan over time (see module docstring)
     fused_scan: bool = False
@@ -122,7 +125,10 @@ class StackedLSTM(nn.Module):
                 step = jax.checkpoint(step)
 
             (h_t, c_t), hs = jax.lax.scan(
-                step, (h0, c0), x_proj.swapaxes(0, 1), unroll=self.unroll
+                step,
+                (h0, c0),
+                x_proj.swapaxes(0, 1),
+                unroll=self.unroll if self.unroll >= 1 else x_proj.shape[1],
             )
             inputs = hs.swapaxes(0, 1)  # (B, T, H)
             final_states.append((h_t, c_t))
@@ -171,6 +177,9 @@ class StackedLSTM(nn.Module):
             step = jax.checkpoint(step)
 
         final, hs_top = jax.lax.scan(
-            step, states, x_proj0.swapaxes(0, 1), unroll=self.unroll
+            step,
+            states,
+            x_proj0.swapaxes(0, 1),
+            unroll=self.unroll if self.unroll >= 1 else x_proj0.shape[1],
         )
         return hs_top.swapaxes(0, 1), [tuple(s) for s in final]

@@ -233,7 +233,10 @@ class Trainer:
         import threading
 
         if self._writer is None:
-            self._write_queue = queue.Queue()
+            # Bounded: each entry holds a full serialized state blob, so an
+            # out_dir slower than the epoch cadence must apply backpressure
+            # (enqueue blocks) instead of growing host memory without limit.
+            self._write_queue = queue.Queue(maxsize=4)
 
             def worker():
                 while True:
@@ -378,6 +381,25 @@ class Trainer:
         history = {"train": [], "validate": []}
         self._log(f"Training starts at: {time.ctime()}")
         start_epoch = self.epoch + 1
+        try:
+            self._epoch_loop(history, start_epoch)
+        except BaseException:
+            # Queued async checkpoint writes must land even when the loop
+            # dies (preemption, OOM, Ctrl-C) — the writer is a daemon
+            # thread, killed at interpreter exit with whatever it still
+            # holds; without this, latest.ckpt can silently be epochs
+            # stale. But the in-flight exception stays the primary one: a
+            # flush failure here is logged, not raised over it.
+            try:
+                self.flush_checkpoints()
+            except Exception as flush_exc:
+                self._log(f"checkpoint flush failed during teardown: {flush_exc}")
+            raise
+        self.flush_checkpoints()
+        self._log(f"Training ends at: {time.ctime()}")
+        return history
+
+    def _epoch_loop(self, history: dict, start_epoch: int) -> None:
         for epoch in range(start_epoch, self.n_epochs + 1):
             self.epoch = epoch
             t0 = time.time()
@@ -429,26 +451,32 @@ class Trainer:
             if self.patience_left == 0:
                 self._log(f"Early stopping at epoch {epoch}..")
                 break
-        self.flush_checkpoints()
-        self._log(f"Training ends at: {time.ctime()}")
-        return history
 
     def _load_state(self, path: str):
         """Read a checkpoint — on the lead process only in multi-host jobs,
         broadcasting the state to everyone else (module docstring)."""
-        self.flush_checkpoints()  # a pending async write may own this path
         if jax.process_count() == 1:
+            self.flush_checkpoints()  # a pending async write may own this path
             return load_checkpoint(path, self.params, self.opt_state)
         import json as _json
 
         from jax.experimental import multihost_utils
 
+        # Lead-side failures (flush or read) are encoded into the broadcast
+        # payload so every process raises together — a lead that raised
+        # *before* the collective would leave the others blocked in it.
+        params, opt_state = self.params, self.opt_state
+        blob = np.zeros(0, np.uint8)
         if self.is_lead:
-            meta, params, opt_state = load_checkpoint(path, self.params, self.opt_state)
+            try:
+                self.flush_checkpoints()
+                meta, params, opt_state = load_checkpoint(
+                    path, self.params, self.opt_state
+                )
+            except Exception as e:
+                meta = {"__load_error__": f"{type(e).__name__}: {e}"}
+                params, opt_state = self.params, self.opt_state
             blob = np.frombuffer(_json.dumps(meta).encode(), dtype=np.uint8)
-        else:
-            params, opt_state = self.params, self.opt_state
-            blob = np.zeros(0, np.uint8)
         n = int(multihost_utils.broadcast_one_to_all(np.int64(blob.size)))
         buf = np.zeros(n, np.uint8)
         if self.is_lead:
@@ -456,6 +484,10 @@ class Trainer:
         meta = _json.loads(bytes(np.asarray(
             multihost_utils.broadcast_one_to_all(buf)
         )).decode())
+        if "__load_error__" in meta:
+            raise RuntimeError(
+                f"lead process failed to load {path}: {meta['__load_error__']}"
+            )
         params = multihost_utils.broadcast_one_to_all(params)
         opt_state = multihost_utils.broadcast_one_to_all(opt_state)
         return meta, params, opt_state

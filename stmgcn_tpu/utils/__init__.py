@@ -3,16 +3,24 @@
 from stmgcn_tpu.utils.comm import collective_stats, step_comm_report
 from stmgcn_tpu.utils.flops import device_peak_flops, mfu, stmgcn_step_flops
 from stmgcn_tpu.utils.platform import force_host_platform
-from stmgcn_tpu.utils.profiling import StepTimer, region_timesteps_per_sec, trace
+from stmgcn_tpu.utils.profiling import (
+    StepTimer,
+    fence,
+    region_timesteps_per_sec,
+    time_chained,
+    trace,
+)
 
 __all__ = [
     "StepTimer",
     "collective_stats",
     "device_peak_flops",
+    "fence",
     "force_host_platform",
     "mfu",
     "region_timesteps_per_sec",
     "step_comm_report",
     "stmgcn_step_flops",
+    "time_chained",
     "trace",
 ]

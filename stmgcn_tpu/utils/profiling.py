@@ -3,10 +3,21 @@
 The reference's only observability is ``time.ctime()`` prints at phase
 boundaries (``Model_Trainer.py:21,62,74,96``; SURVEY.md §5.a). Here:
 
-- :class:`StepTimer` — steady-state step timing with device-completion
-  fences (``block_until_ready``), warmup exclusion, and percentile
-  summaries; wall-clock-only timing of async dispatch is the classic JAX
-  benchmarking mistake.
+- :func:`fence` — force device completion via a value readback.
+  ``jax.block_until_ready`` is NOT a reliable fence on every backend: on
+  this image's tunneled ``axon`` TPU plugin it returns while the
+  computation is still in flight, which silently turns "fenced" timings
+  into dispatch timings (measured: a train step "timed" at 1 ms that a
+  readback proves takes 82 ms). Fetching a computed scalar to the host
+  cannot lie — the executable must have finished to produce it.
+- :func:`time_chained` — the honest steady-state methodology on a
+  remote-tunneled device: time N *chained* steps (each consuming the
+  previous step's outputs) and fence once at the end, so the per-sync
+  round-trip (~68 ms over the tunnel) is amortized instead of billed to
+  every step.
+- :class:`StepTimer` — per-step timing with a readback fence per step.
+  Correct everywhere, but on a tunneled backend each fence pays a full
+  round-trip, so prefer :func:`time_chained` for throughput numbers.
 - :func:`trace` — context manager around ``jax.profiler`` trace capture
   for TensorBoard/XProf (per-op device timelines, fusion inspection).
 - :func:`region_timesteps_per_sec` — the framework's north-star
@@ -19,13 +30,64 @@ import contextlib
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["StepTimer", "region_timesteps_per_sec", "trace"]
+__all__ = [
+    "StepTimer",
+    "fence",
+    "region_timesteps_per_sec",
+    "time_chained",
+    "trace",
+]
+
+
+def fence(tree) -> None:
+    """Block until ``tree``'s computation has finished, via value readback.
+
+    First waits with ``jax.block_until_ready`` (correct and cheap on
+    well-behaved backends, covers every leaf including outputs of
+    independent dispatches), then reads one scalar element of one leaf
+    back to the host — outputs of a jitted call come from one executable,
+    so a materialized value implies the call completed, and for a chain of
+    calls fencing the last forces every predecessor. The readback is what
+    makes this hold on the tunneled ``axon`` backend, where
+    ``block_until_ready`` returns while work is still in flight (module
+    docstring). Callers timing trees that mix *independent* dispatches on
+    such a backend should fence the legs separately.
+    """
+    jax.block_until_ready(tree)
+    leaves = jax.tree_util.tree_leaves(tree)
+    for leaf in reversed(leaves):  # prefer the last (e.g. a loss scalar)
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
+            jax.device_get(jnp.ravel(leaf)[0])
+            return
+
+
+def time_chained(step, iters: int, warmup: int = 3) -> float:
+    """Mean seconds/step of ``step`` over ``iters`` chained calls.
+
+    ``step()`` must perform one iteration whose inputs depend on the
+    previous iteration's outputs (e.g. by closing over and rebinding
+    ``params``/``opt_state``) and return something :func:`fence` can read.
+    The fence happens once after the timed loop, so the measurement is
+    dispatch-pipelined steady state — the honest number on a backend where
+    every individual sync costs a network round-trip.
+    """
+    out = None
+    for _ in range(warmup):
+        out = step()
+    if out is not None:
+        fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step()
+    fence(out)
+    return (time.perf_counter() - t0) / iters
 
 
 class StepTimer:
-    """Measure per-step wall time with proper device fencing.
+    """Measure per-step wall time with a readback fence per step.
 
     Usage::
 
@@ -33,6 +95,10 @@ class StepTimer:
         for batch in batches:
             result = timer.measure(train_step, params, opt_state, *batch)
         print(timer.summary())
+
+    On a remote-tunneled backend each per-step fence costs a full round
+    trip that is billed to the step; use :func:`time_chained` when the
+    quantity of interest is steady-state throughput.
     """
 
     def __init__(self, warmup: int = 3):
@@ -44,7 +110,7 @@ class StepTimer:
         """Run ``fn``, fence its result on device completion, record the time."""
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
-        jax.block_until_ready(out)
+        fence(out)
         self.record(time.perf_counter() - t0)
         return out
 

@@ -27,6 +27,7 @@ def _out(model, params, x):
 @pytest.mark.parametrize("variant", [
     dict(unroll=3), dict(unroll=12), dict(fused_scan=True),
     dict(fused_scan=True, unroll=4), dict(fused_scan=True, remat=True),
+    dict(unroll=0), dict(fused_scan=True, unroll=0),  # 0 = full unroll
 ])
 def test_variant_matches_default(data, variant):
     base = StackedLSTM(hidden_dim=8, num_layers=3)

@@ -216,8 +216,9 @@ def main() -> None:
     else:
         schedules = {"plain": (1, False), "tuned": (0, True)}
     if probe_err is not None:
-        dtypes = ("float32",)  # CPU fallback: keep it cheap
-        schedules = {"plain": (1, False)}
+        dtypes = ("float32",)  # CPU fallback: keep it cheap — but an
+        if not CUSTOM_SCHEDULE:  # explicitly requested schedule is kept
+            schedules = {"plain": (1, False)}
 
     results = {}
     measure_err = None

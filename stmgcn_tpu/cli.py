@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use the Pallas block-CSR SpMM path for graph convs")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--out-dir", type=str, default=None)
+    p.add_argument("--data-placement", choices=("auto", "resident", "stream"),
+                   default=None,
+                   help="batch data residency: upload splits once and gather "
+                        "on device (resident), upload per batch with "
+                        "prefetch (stream), or pick by device/size (auto)")
     p.add_argument("--normalize", choices=("minmax", "std", "none"), default=None,
                    help="demand normalization (reference parity: minmax to "
                         "[-1,1]; stats travel inside checkpoints either way)")
@@ -149,7 +154,7 @@ def config_from_args(args) -> "ExperimentConfig":
         ("epochs", "epochs"), ("batch_size", "batch_size"), ("lr", "lr"),
         ("weight_decay", "weight_decay"), ("loss", "loss"),
         ("patience", "patience"), ("top_k", "top_k"), ("seed", "seed"),
-        ("out_dir", "out_dir"),
+        ("out_dir", "out_dir"), ("data_placement", "data_placement"),
     ]:
         val = getattr(args, field)
         if val is not None:

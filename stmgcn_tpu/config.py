@@ -120,6 +120,13 @@ class TrainConfig:
     #: batches placed on device ahead of the consuming step (0 disables);
     #: overlaps host->device copies with device compute
     prefetch: int = 1
+    #: where batch data lives: "stream" uploads every batch (prefetch
+    #: overlaps the copy), "resident" uploads each split once and gathers
+    #: batches on device by index (the reference's whole-split residency,
+    #: Data_Container.py:88-89, minus its eager-in-the-dataset placement),
+    #: "auto" picks resident on a single device when the windowed arrays
+    #: fit comfortably in HBM, else stream
+    data_placement: str = "auto"
     #: write checkpoint files from a background worker (serialization —
     #: the device->host snapshot — stays on the training thread; reads
     #: flush pending writes first)

@@ -46,9 +46,16 @@ class Batch:
     #: which city's graphs this batch belongs to (always 0 when cities
     #: share one graph stack; batches never mix cities with differing graphs)
     city: int = 0
+    #: positions of these samples in the mode's (city-relative) arrays —
+    #: shuffled order and tail padding included, so a device-resident
+    #: consumer can gather ``arrays(mode)[indices]`` instead of uploading
+    #: ``x``/``y`` (``Trainer``'s resident data placement). With
+    #: ``batches(with_arrays=False)`` the indices are the *only* payload
+    #: (``x``/``y`` are None — not even materialized on the host).
+    indices: np.ndarray | None = None
 
     def __len__(self) -> int:
-        return self.y.shape[0]
+        return self.y.shape[0] if self.y is not None else len(self.indices)
 
 
 class DemandDataset:
@@ -131,6 +138,12 @@ class DemandDataset:
         return self._ys[0].shape[0]
 
     @property
+    def nbytes(self) -> int:
+        """Total bytes of the windowed sample arrays (all cities, all modes)
+        — what a device-resident consumer would upload."""
+        return sum(a.nbytes for a in self._xs) + sum(a.nbytes for a in self._ys)
+
+    @property
     def n_samples(self) -> int:
         return self.samples_per_city * self.n_cities
 
@@ -190,6 +203,7 @@ class DemandDataset:
         epoch: int = 0,
         drop_last: bool = False,
         pad_last: bool = False,
+        with_arrays: bool = True,
     ) -> Iterator[Batch]:
         """Yield :class:`Batch` es over a mode.
 
@@ -197,6 +211,10 @@ class DemandDataset:
         batch has the same static shape under ``jit``; ``Batch.n_real`` lets
         the loss/metrics mask the padding. ``shuffle`` reshuffles per epoch
         with a deterministic ``(seed, epoch)`` stream.
+
+        ``with_arrays=False`` yields index-only batches (``x``/``y`` None):
+        a device-resident consumer gathers on device from ``Batch.indices``,
+        so materializing host copies here would be pure waste.
 
         With per-city graphs (``shared_graphs=False``) batches never mix
         cities — every batch carries the ``city`` whose support stack
@@ -207,17 +225,18 @@ class DemandDataset:
         if self.shared_graphs:
             yield from self._iter_arrays(
                 self.arrays(mode), 0, batch_size, shuffle, (seed,), epoch,
-                drop_last, pad_last,
+                drop_last, pad_last, with_arrays,
             )
             return
         for city in range(self.n_cities):
             yield from self._iter_arrays(
                 self.city_arrays(mode, city), city, batch_size, shuffle,
-                (seed, city), epoch, drop_last, pad_last,
+                (seed, city), epoch, drop_last, pad_last, with_arrays,
             )
 
     def _iter_arrays(
-        self, arrays, city, batch_size, shuffle, seed_key, epoch, drop_last, pad_last
+        self, arrays, city, batch_size, shuffle, seed_key, epoch, drop_last,
+        pad_last, with_arrays=True,
     ) -> Iterator[Batch]:
         x, y = arrays
         n = y.shape[0]
@@ -227,10 +246,22 @@ class DemandDataset:
         stop = n - n % batch_size if drop_last else n
         for i in range(0, stop, batch_size):
             idx = slice(i, min(i + batch_size, n))
-            bx, by = (x[order[idx]], y[order[idx]]) if order is not None else (x[idx], y[idx])
-            n_real = by.shape[0]
+            if order is not None:
+                sel = order[idx]
+            else:
+                sel = np.arange(i, min(i + batch_size, n))
+            n_real = sel.shape[0]
             if pad_last and n_real < batch_size:
-                reps = batch_size - n_real
+                sel = np.concatenate([sel, np.repeat(sel[-1:], batch_size - n_real)])
+            if not with_arrays:
+                yield Batch(x=None, y=None, n_real=n_real, city=city, indices=sel)
+                continue
+            if order is not None:
+                bx, by = x[sel[:n_real]], y[sel[:n_real]]
+            else:  # contiguous: keep the zero-copy views
+                bx, by = x[idx], y[idx]
+            if n_real < sel.shape[0]:  # padded tail
+                reps = sel.shape[0] - n_real
                 bx = np.concatenate([bx, np.repeat(bx[-1:], reps, axis=0)])
                 by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
-            yield Batch(x=bx, y=by, n_real=n_real, city=city)
+            yield Batch(x=bx, y=by, n_real=n_real, city=city, indices=sel)

@@ -328,6 +328,7 @@ def build_trainer(
         patience=t.patience,
         top_k=t.top_k,
         prefetch=t.prefetch,
+        data_placement=t.data_placement,
         async_checkpoint=t.async_checkpoint,
         shuffle=t.shuffle,
         seed=t.seed,

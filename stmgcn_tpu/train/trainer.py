@@ -14,7 +14,13 @@ with the same control semantics, restructured for JAX:
   ``jax.eval_shape``-free pure eval (the reference forgot ``no_grad``,
   quirk 5);
 - per-epoch JSONL records land in ``<out_dir>/history.jsonl`` in addition
-  to stdout prints (SURVEY.md §5.e).
+  to stdout prints (SURVEY.md §5.e);
+- batch data placement: ``data_placement="resident"`` uploads each split
+  to the device once and gathers batches by index on device (per-batch
+  host->device copies leave the epoch entirely; single-device only),
+  ``"stream"`` uploads per batch with ``prefetch`` overlap, ``"auto"``
+  (default) picks resident on a single device when the windowed arrays
+  fit comfortably in HBM.
 
 Multi-host note: only the lead process touches ``out_dir`` — writes
 always, and in multi-process jobs reads too: ``restore()``/``test()``
@@ -96,6 +102,11 @@ class _DefaultPlacement:
 class Trainer:
     """Drives training of a flax model over a :class:`DemandDataset`."""
 
+    #: "auto" data placement goes resident up to this many windowed-array
+    #: bytes (well under any TPU generation's HBM; the model state at this
+    #: scale is tiny next to it)
+    RESIDENT_CAP_BYTES = 1 << 30
+
     def __init__(
         self,
         model,
@@ -114,6 +125,7 @@ class Trainer:
         top_k: int = 1,
         prefetch: int = 1,
         node_pad: int = 0,
+        data_placement: str = "auto",
         async_checkpoint: bool = True,
         placement=None,
         extra_meta: Optional[dict] = None,
@@ -136,6 +148,12 @@ class Trainer:
         #: padded rows are isolated (zero supports), excluded from the gate
         #: pooling (model.n_real_nodes) and masked out of the loss/metrics
         self.node_pad = node_pad
+        if data_placement not in ("auto", "resident", "stream"):
+            raise ValueError(
+                f"data_placement must be auto|resident|stream, got {data_placement!r}"
+            )
+        self.data_placement = data_placement
+        self._resident_cache: dict = {}
         #: serialize on the training thread (device->host snapshot), write
         #: the file from a background worker — IO leaves the epoch's
         #: critical path. Reads (restore/test) flush pending writes first.
@@ -167,6 +185,24 @@ class Trainer:
             self.supports = supports.map(lambda s: self.placement.put(s, "supports"))
         else:
             self.supports = self.placement.put(supports, "supports")
+        # Resident data placement: upload each split once and gather
+        # batches on device by index — the per-batch host->device copy
+        # leaves the epoch entirely (SURVEY.md §7 "device_put once" for
+        # small configs; the reference's whole-split residency, quirk 7,
+        # without its eager-in-the-dataset placement). Mesh placements
+        # stream: resident gathers would need per-shard index translation
+        # for data that mesh configs assume is too big to replicate anyway.
+        meshy = hasattr(self.placement, "mesh")
+        if self.data_placement == "resident" and meshy:
+            raise ValueError(
+                "data_placement='resident' requires a single-device "
+                "placement; mesh runs stream batches (with prefetch)"
+            )
+        self._resident = self.data_placement == "resident" or (
+            self.data_placement == "auto"
+            and not meshy
+            and dataset.nbytes <= self.RESIDENT_CAP_BYTES
+        )
 
         for mode in ("train", "validate"):
             if dataset.mode_size(mode) == 0:
@@ -176,7 +212,7 @@ class Trainer:
                 )
         self.step_fns = make_step_fns(model, make_optimizer(lr, weight_decay), loss)
         example = next(dataset.batches("train", batch_size, pad_last=True))
-        example_x, _, _ = self._place_batch(example)  # node-padded when needed
+        example_x, _, _ = self._place_batch(example, "train")  # node-padded when needed
         self.params, self.opt_state = self.step_fns.init(
             jax.random.key(seed), self._supports_for(example), example_x
         )
@@ -298,7 +334,9 @@ class Trainer:
             return self.supports.for_city(batch.city)
         return self.supports
 
-    def _placed_batches(self, mode: str, *, shuffle: bool = False):
+    def _placed_batches(
+        self, mode: str, *, shuffle: bool = False, with_arrays: bool | None = None
+    ):
         """Iterate ``(batch, (x, y, mask))`` with placement run ahead.
 
         ``device_put`` issues the host->device copy asynchronously, so
@@ -307,9 +345,15 @@ class Trainer:
         step's critical path (the reference instead uploads whole splits
         eagerly, ``Data_Container.py:88-89``). ``prefetch`` batches are kept
         in flight (host refs released as consumed).
+
+        Resident placement iterates index-only batches (no host copies at
+        all); callers that read ``batch.x``/``batch.y`` on the host (e.g.
+        ``test()``'s metric accumulation) pass ``with_arrays=True``.
         """
         import collections
 
+        if with_arrays is None:
+            with_arrays = not self._resident
         queue: collections.deque = collections.deque()
         for batch in self.dataset.batches(
             mode,
@@ -318,31 +362,56 @@ class Trainer:
             seed=self.seed,
             epoch=self.epoch,
             pad_last=True,
+            with_arrays=with_arrays,
         ):
-            queue.append((batch, self._place_batch(batch)))
+            queue.append((batch, self._place_batch(batch, mode)))
             if len(queue) > self.prefetch:
                 yield queue.popleft()
         while queue:
             yield queue.popleft()
 
-    def _place_batch(self, batch):
-        bx, by = batch.x, batch.y
+    def _place_batch(self, batch, mode: str):
         sample_mask = (np.arange(len(batch)) < batch.n_real).astype(np.float32)
+        if self._resident and batch.indices is not None:
+            x_all, y_all = self._resident_arrays(mode, batch.city)
+            mask = self._mask(sample_mask, y_all.shape[y_all.ndim - 2])
+            idx = jnp.asarray(batch.indices)  # a few hundred bytes, not the data
+            return jnp.take(x_all, idx, axis=0), jnp.take(y_all, idx, axis=0), mask
+        mask = self._mask(sample_mask, batch.y.shape[batch.y.ndim - 2] + self.node_pad)
+        bx, by = batch.x, batch.y
         if self.node_pad:
-            node_axis_x, node_axis_y = 2, by.ndim - 2  # (B,T,N,C); (B,[H,]N,C)
-            bx = self._pad_nodes(bx, node_axis_x)
-            by = self._pad_nodes(by, node_axis_y)
+            bx = self._pad_nodes(bx, 2)  # (B,T,N,C)
+            by = self._pad_nodes(by, by.ndim - 2)  # (B,[H,]N,C)
+        return self.placement.put(bx, "x"), self.placement.put(by, "y"), mask
+
+    def _mask(self, sample_mask, n_padded_nodes: int):
+        """Loss mask: samples, crossed with real-node rows when node-padded."""
+        if self.node_pad:
             node_mask = (
-                np.arange(by.shape[node_axis_y]) < by.shape[node_axis_y] - self.node_pad
+                np.arange(n_padded_nodes) < n_padded_nodes - self.node_pad
             ).astype(np.float32)
             mask = sample_mask[:, None] * node_mask[None, :]
         else:
             mask = sample_mask
-        return (
-            self.placement.put(bx, "x"),
-            self.placement.put(by, "y"),
-            self.placement.put(mask, "mask"),
-        )
+        return self.placement.put(mask, "mask")
+
+    def _resident_arrays(self, mode: str, city: int):
+        """Device copies of a mode's full (x, y), uploaded once per run."""
+        key = (mode, city)
+        if key not in self._resident_cache:
+            x, y = (
+                self.dataset.arrays(mode)
+                if self.dataset.shared_graphs
+                else self.dataset.city_arrays(mode, city)
+            )
+            if self.node_pad:
+                x = self._pad_nodes(x, 2)
+                y = self._pad_nodes(y, y.ndim - 2)
+            self._resident_cache[key] = (
+                self.placement.put(x, "x"),
+                self.placement.put(y, "y"),
+            )
+        return self._resident_cache[key]
 
     def _pad_nodes(self, arr, axis: int):
         widths = [(0, 0)] * arr.ndim
@@ -524,7 +593,8 @@ class Trainer:
         results = {}
         for mode in modes:
             preds, trues = [], []
-            for batch, (x, y, mask) in self._placed_batches(mode):
+            # metric accumulation reads batch.y on the host — keep arrays
+            for batch, (x, y, mask) in self._placed_batches(mode, with_arrays=True):
                 _, pred = self.step_fns.eval_step(
                     params, self._supports_for(batch), x, y, mask
                 )

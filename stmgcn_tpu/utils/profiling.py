@@ -62,6 +62,12 @@ def fence(tree) -> None:
         if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
             jax.device_get(jnp.ravel(leaf)[0])
             return
+    raise ValueError(
+        "fence: no non-empty array leaf to read back — on backends where "
+        "block_until_ready does not actually fence (module docstring), a "
+        "silent pass here would turn timings into dispatch-only numbers; "
+        "return (or pass) at least one computed array"
+    )
 
 
 def time_chained(step, iters: int, warmup: int = 3) -> float:

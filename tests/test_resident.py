@@ -1,0 +1,108 @@
+"""Device-resident data placement: splits uploaded once, batches gathered
+on device by index — must train identically to the streaming path.
+
+The streaming path uploads every batch's arrays (with prefetch overlap);
+the resident path ships only a per-batch index vector. Same samples, same
+order, same masks => identical losses, histories, and predictions.
+"""
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+from stmgcn_tpu.experiment import build_trainer
+
+
+def _run(data_placement, rows=5, epochs=2, shuffle=False, **cfg_over):
+    cfg = preset("smoke")
+    cfg.data.rows = rows
+    cfg.data.n_timesteps = 24 * 7 * 2 + 60
+    cfg.train.epochs = epochs
+    cfg.train.batch_size = 8
+    cfg.train.data_placement = data_placement
+    cfg.train.shuffle = shuffle
+    for k, v in cfg_over.items():
+        setattr(cfg.train, k, v)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        cfg.train.out_dir = d
+        trainer = build_trainer(cfg, verbose=False)
+        assert trainer._resident == (data_placement != "stream")
+        history = trainer.train()
+        results = trainer.test(modes=("test",), checkpoint=None)
+    return history, results
+
+
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_resident_matches_stream(shuffle):
+    h_res, r_res = _run("resident", shuffle=shuffle)
+    h_str, r_str = _run("stream", shuffle=shuffle)
+    np.testing.assert_allclose(h_res["train"], h_str["train"], rtol=1e-6)
+    np.testing.assert_allclose(h_res["validate"], h_str["validate"], rtol=1e-6)
+    assert r_res["test"]["rmse"] == pytest.approx(r_str["test"]["rmse"], rel=1e-6)
+
+
+def test_auto_is_resident_on_single_device_small_data():
+    h_auto, _ = _run("auto")
+    h_res, _ = _run("resident")
+    np.testing.assert_allclose(h_auto["train"], h_res["train"], rtol=0)
+
+
+def test_batch_indices_cover_modes_and_padding():
+    data = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 50, seed=0)
+    ds = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    for mode in ("train", "validate", "test"):
+        x_all, y_all = ds.arrays(mode)
+        seen = []
+        for b in ds.batches(mode, 8, pad_last=True):
+            assert b.indices is not None and len(b.indices) == len(b)
+            np.testing.assert_array_equal(x_all[b.indices], b.x)
+            np.testing.assert_array_equal(y_all[b.indices], b.y)
+            seen.extend(b.indices[: b.n_real].tolist())
+        assert sorted(seen) == list(range(y_all.shape[0]))
+
+
+def test_shuffled_indices_match_batch_content():
+    data = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 50, seed=0)
+    ds = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    x_all, y_all = ds.arrays("train")
+    seen = []
+    for b in ds.batches("train", 8, shuffle=True, seed=3, epoch=2, pad_last=True):
+        np.testing.assert_array_equal(x_all[b.indices], b.x)
+        seen.extend(b.indices[: b.n_real].tolist())
+    assert sorted(seen) == list(range(y_all.shape[0]))  # a true permutation
+
+
+def test_index_only_batches_skip_host_copies():
+    data = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 50, seed=0)
+    ds = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    full = list(ds.batches("train", 8, shuffle=True, seed=1, epoch=4, pad_last=True))
+    lean = list(
+        ds.batches(
+            "train", 8, shuffle=True, seed=1, epoch=4, pad_last=True,
+            with_arrays=False,
+        )
+    )
+    assert len(full) == len(lean)
+    for f, l in zip(full, lean):
+        assert l.x is None and l.y is None
+        assert len(l) == len(f) and l.n_real == f.n_real
+        np.testing.assert_array_equal(l.indices, f.indices)
+
+
+def test_resident_rejected_on_mesh():
+    cfg = preset("multicity")
+    cfg.mesh.n_virtual_devices = 8
+    cfg.train.data_placement = "resident"
+    with pytest.raises(ValueError, match="resident"):
+        build_trainer(cfg, verbose=False)
+
+
+def test_mesh_auto_streams():
+    cfg = preset("multicity")
+    cfg.mesh.n_virtual_devices = 8
+    cfg.train.data_placement = "auto"
+    trainer = build_trainer(cfg, verbose=False)
+    assert trainer._resident is False

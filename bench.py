@@ -216,8 +216,11 @@ def main() -> None:
     else:
         schedules = {"plain": (1, False), "tuned": (0, True)}
     if probe_err is not None:
-        dtypes = ("float32",)  # CPU fallback: keep it cheap — but an
-        if not CUSTOM_SCHEDULE:  # explicitly requested schedule is kept
+        # CPU fallback: keep it cheap — but explicitly requested knobs
+        # (dtype, schedule) are honored, not silently replaced
+        if "STMGCN_BENCH_DTYPE" not in os.environ:
+            dtypes = ("float32",)
+        if not CUSTOM_SCHEDULE:
             schedules = {"plain": (1, False)}
 
     results = {}

@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--cheb-k", type=int, default=None, help="max polynomial order K")
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default=None)
+    p.add_argument("--lstm-backend", choices=("xla", "pallas"), default=None,
+                   help="LSTM recurrence implementation: lax.scan (xla) or "
+                        "the fused Pallas TPU kernel (pallas)")
     p.add_argument("--lstm-unroll", type=int, default=None,
                    help="lax.scan unroll factor for the LSTM recurrence")
     p.add_argument("--lstm-fused", action="store_true", default=None,
@@ -175,6 +178,8 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.model.lstm_unroll = args.lstm_unroll
     if args.lstm_fused:
         cfg.model.lstm_fused_scan = True
+    if args.lstm_backend is not None:
+        cfg.model.lstm_backend = args.lstm_backend
     if args.branch_parallel is not None:
         cfg.mesh.branch = args.branch_parallel
     if args.region_strategy is not None:

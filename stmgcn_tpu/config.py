@@ -90,6 +90,8 @@ class ModelConfig:
     #: unroll factor for the time scan, and single-scan-all-layers fusion
     lstm_unroll: int = 1
     lstm_fused_scan: bool = False
+    #: "xla" | "pallas" — scan paths vs the hand-written fused TPU kernel
+    lstm_backend: str = "xla"
     dtype: str = "float32"
 
     @property

@@ -268,6 +268,7 @@ def build_model(
         remat=m.remat,
         lstm_unroll=m.lstm_unroll,
         lstm_fused_scan=m.lstm_fused_scan,
+        lstm_backend=m.lstm_backend,
         dtype=m.compute_dtype if m.dtype != "float32" else None,
     )
 

@@ -110,6 +110,7 @@ class CGLSTM(nn.Module):
     remat: bool = False
     lstm_unroll: int = 1
     lstm_fused_scan: bool = False
+    lstm_backend: str = "xla"
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -138,6 +139,7 @@ class CGLSTM(nn.Module):
             remat=self.remat,
             unroll=self.lstm_unroll,
             fused_scan=self.lstm_fused_scan,
+            backend=self.lstm_backend,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="lstm",

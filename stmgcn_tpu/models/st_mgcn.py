@@ -46,6 +46,7 @@ class Branch(nn.Module):
     remat: bool = False
     lstm_unroll: int = 1
     lstm_fused_scan: bool = False
+    lstm_backend: str = "xla"
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -65,6 +66,7 @@ class Branch(nn.Module):
             remat=self.remat,
             lstm_unroll=self.lstm_unroll,
             lstm_fused_scan=self.lstm_fused_scan,
+            lstm_backend=self.lstm_backend,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="cg_lstm",
@@ -125,6 +127,8 @@ class STMGCN(nn.Module):
     #: (pure XLA scheduling levers; numerically identical either way)
     lstm_unroll: int = 1
     lstm_fused_scan: bool = False
+    #: "xla" (scan) or "pallas" (hand-written fused kernel, ops/pallas_lstm.py)
+    lstm_backend: str = "xla"
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -157,6 +161,7 @@ class STMGCN(nn.Module):
             remat=self.remat,
             lstm_unroll=self.lstm_unroll,
             lstm_fused_scan=self.lstm_fused_scan,
+            lstm_backend=self.lstm_backend,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )

@@ -69,6 +69,12 @@ class StackedLSTM(nn.Module):
     unroll: int = 1
     #: run all layers inside one scan over time (see module docstring)
     fused_scan: bool = False
+    #: "xla" runs the scan paths above; "pallas" runs the whole T x L
+    #: recurrence as one hand-written TPU kernel pair with VMEM-resident
+    #: states and a recomputing backward (ops/pallas_lstm.py). Same
+    #: parameters, same math (equality-tested); explicit initial states
+    #: fall back to the scan path.
+    backend: str = "xla"
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -97,6 +103,10 @@ class StackedLSTM(nn.Module):
         x: jnp.ndarray,
         initial_states: Optional[list] = None,
     ) -> tuple[jnp.ndarray, list]:
+        if self.backend not in ("xla", "pallas"):
+            raise ValueError(f"backend must be xla|pallas, got {self.backend!r}")
+        if self.backend == "pallas" and initial_states is None:
+            return self._pallas(x)
         if self.fused_scan:
             return self._fused(x, initial_states)
         batch = x.shape[0]
@@ -134,19 +144,41 @@ class StackedLSTM(nn.Module):
             final_states.append((h_t, c_t))
         return inputs, final_states
 
-    def _fused(self, x: jnp.ndarray, initial_states: Optional[list]):
-        """All layers in one scan; only the top layer's sequence is kept."""
-        batch = x.shape[0]
-        h_dim = self.hidden_dim
+    def _collect_params(self, x: jnp.ndarray):
+        """All layers' ``(wx, wh, b)`` promoted with ``x`` to compute dtype."""
         params = []
         in_dim = x.shape[-1]
         for layer in range(self.num_layers):
             params.append(self._layer_params(layer, in_dim))
-            in_dim = h_dim
+            in_dim = self.hidden_dim
         x, *flat = nn.dtypes.promote_dtype(
             x, *(p for lp in params for p in lp), dtype=self.dtype
         )
-        params = [tuple(flat[3 * i : 3 * i + 3]) for i in range(self.num_layers)]
+        return x, [tuple(flat[3 * i : 3 * i + 3]) for i in range(self.num_layers)]
+
+    def _pallas(self, x: jnp.ndarray):
+        """Hand-written fused kernel path (zero initial state only)."""
+        from stmgcn_tpu.ops.pallas_lstm import fused_lstm
+
+        L, h_dim = self.num_layers, self.hidden_dim
+        x, params = self._collect_params(x)
+        wx0, _, b0 = params[0]
+        x_proj0 = x @ wx0 + b0
+        wh_stack = jnp.stack([p[1] for p in params])
+        if L > 1:
+            wx_stack = jnp.stack([params[layer][0] for layer in range(1, L)])
+            b_stack = jnp.stack([params[layer][2] for layer in range(1, L)])
+        else:  # never-read placeholder: the kernel operand can't be empty
+            wx_stack = jnp.zeros((1, h_dim, 4 * h_dim), x_proj0.dtype)
+            b_stack = jnp.zeros((1, 4 * h_dim), x_proj0.dtype)
+        hs_top, h_fin, c_fin = fused_lstm(x_proj0, wh_stack, wx_stack, b_stack)
+        return hs_top, [(h_fin[layer], c_fin[layer]) for layer in range(L)]
+
+    def _fused(self, x: jnp.ndarray, initial_states: Optional[list]):
+        """All layers in one scan; only the top layer's sequence is kept."""
+        batch = x.shape[0]
+        h_dim = self.hidden_dim
+        x, params = self._collect_params(x)
 
         # Layer 0's input projection is still hoisted; deeper layers consume
         # the previous layer's fresh h inside the step.

@@ -1,0 +1,307 @@
+"""Fused multi-layer LSTM recurrence as a single Pallas TPU kernel pair.
+
+The shared LSTM is ~93% of the flagship's step FLOPs (BASELINE.md), so it
+is the one op worth a hand kernel. TPU-native counterpart of the engine
+kernel the reference gets from cuDNN (``/root/reference/STMGCN.py:21,48``
+— ``nn.LSTM``'s fused implementation), built the Pallas way rather than
+translated:
+
+- the layer-0 input projection for all T steps stays **outside** the
+  kernel as one large XLA matmul (MXU-shaped, batched over ``R*T`` rows —
+  same hoisting as the scan path, ``ops/lstm.py``);
+- one **forward kernel** runs the entire ``T x L`` recurrence for a block
+  of rows with every hidden/cell state living in VMEM — no HBM round
+  trips between steps or layers (grid over row blocks; T and L are
+  static, so the step/layer loops fully unroll into straight-line code);
+- one **backward kernel** runs the reverse sweep, *recomputing* gate
+  pre-activations from the saved per-step ``h``/``c`` sequences instead
+  of storing ``(L, R, T, 4H)`` gate tensors — recompute is MXU-cheap,
+  HBM traffic is the scarce resource (the same trade ``jax.checkpoint``
+  makes, chosen once here and hand-scheduled);
+- weight gradients accumulate across row blocks in revisited output
+  blocks (TPU grids execute sequentially, so ``+=`` into a
+  constant-index block is race-free).
+
+Zero initial state per call is the reference's semantics
+(``STMGCN.py:53-57``); callers that pass explicit initial states use the
+scan path instead. Numerics: the kernel computes in float32 regardless of
+the storage dtype (``preferred_element_type``), so bf16 inputs get f32
+cell arithmetic — at least as accurate as the XLA bf16 scan path it
+replaces; equality with the scan path is pinned by
+``tests/test_pallas_lstm.py`` in both dtypes, gradients included.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_lstm", "pallas_lstm_available"]
+
+#: rows per grid step — sized so fwd residuals + bwd temporaries of a
+#: block stay well inside ~16 MB/core VMEM with pipelining headroom
+_BLOCK_R = 128
+
+
+def pallas_lstm_available() -> bool:
+    """True when the current default backend can run the kernel natively."""
+    return jax.default_backend() == "tpu"
+
+
+def _cell_acts(gates_pre):
+    """(i, f, g, o) activations from pre-activation gates, f32."""
+    i_pre, f_pre, g_pre, o_pre = jnp.split(gates_pre, 4, axis=-1)
+    return (
+        jax.nn.sigmoid(i_pre),
+        jax.nn.sigmoid(f_pre),
+        jnp.tanh(g_pre),
+        jax.nn.sigmoid(o_pre),
+    )
+
+
+def _fwd_kernel(T, L, xp_ref, wh_ref, wx_ref, b_ref, out_ref, hseq_ref, cseq_ref):
+    """Whole T x L recurrence for one row block; states never leave VMEM."""
+    br = xp_ref.shape[0]
+    h_dim = wh_ref.shape[1]
+    f32 = jnp.float32
+    h = [jnp.zeros((br, h_dim), f32) for _ in range(L)]
+    c = [jnp.zeros((br, h_dim), f32) for _ in range(L)]
+    for t in range(T):
+        for layer in range(L):
+            if layer == 0:
+                pre = xp_ref[:, t, :].astype(f32)
+            else:
+                pre = (
+                    jnp.dot(
+                        h[layer - 1],
+                        wx_ref[layer - 1].astype(f32),
+                        preferred_element_type=f32,
+                    )
+                    + b_ref[layer - 1].astype(f32)
+                )
+            pre = pre + jnp.dot(
+                h[layer], wh_ref[layer].astype(f32), preferred_element_type=f32
+            )
+            i, f, g, o = _cell_acts(pre)
+            c[layer] = f * c[layer] + i * g
+            h[layer] = o * jnp.tanh(c[layer])
+            hseq_ref[layer, :, t, :] = h[layer].astype(hseq_ref.dtype)
+            cseq_ref[layer, :, t, :] = c[layer].astype(cseq_ref.dtype)
+        out_ref[:, t, :] = h[L - 1].astype(out_ref.dtype)
+
+
+def _bwd_kernel(
+    T,
+    L,
+    xp_ref,
+    wh_ref,
+    wx_ref,
+    b_ref,
+    hseq_ref,
+    cseq_ref,
+    gout_ref,
+    ghfin_ref,
+    gcfin_ref,
+    dxp_ref,
+    dwh_ref,
+    dwx_ref,
+    db_ref,
+):
+    """Reverse sweep for one row block; gate pre-activations recomputed."""
+    br = xp_ref.shape[0]
+    f32 = jnp.float32
+
+    @pl.when(pl.program_id(0) == 0)
+    def _zero_weight_grads():
+        dwh_ref[...] = jnp.zeros_like(dwh_ref)
+        dwx_ref[...] = jnp.zeros_like(dwx_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    dh = [ghfin_ref[layer].astype(f32) for layer in range(L)]
+    dc = [gcfin_ref[layer].astype(f32) for layer in range(L)]
+    zeros = jnp.zeros((br, wh_ref.shape[1]), f32)
+    for t in reversed(range(T)):
+        dh[L - 1] = dh[L - 1] + gout_ref[:, t, :].astype(f32)
+        for layer in reversed(range(L)):
+            h_prev = hseq_ref[layer, :, t - 1, :].astype(f32) if t > 0 else zeros
+            c_prev = cseq_ref[layer, :, t - 1, :].astype(f32) if t > 0 else zeros
+            c_t = cseq_ref[layer, :, t, :].astype(f32)
+            # recompute this step's pre-activations (cheaper than storing)
+            if layer == 0:
+                pre = xp_ref[:, t, :].astype(f32)
+            else:
+                below = hseq_ref[layer - 1, :, t, :].astype(f32)
+                pre = (
+                    jnp.dot(
+                        below, wx_ref[layer - 1].astype(f32), preferred_element_type=f32
+                    )
+                    + b_ref[layer - 1].astype(f32)
+                )
+            pre = pre + jnp.dot(
+                h_prev, wh_ref[layer].astype(f32), preferred_element_type=f32
+            )
+            i, f, g, o = _cell_acts(pre)
+            tc = jnp.tanh(c_t)
+
+            do = dh[layer] * tc
+            dct = dc[layer] + dh[layer] * o * (1.0 - tc * tc)
+            dgates = jnp.concatenate(
+                [
+                    dct * g * i * (1.0 - i),  # d i_pre
+                    dct * c_prev * f * (1.0 - f),  # d f_pre
+                    dct * i * (1.0 - g * g),  # d g_pre
+                    do * o * (1.0 - o),  # d o_pre
+                ],
+                axis=-1,
+            )
+            dh[layer] = jnp.dot(
+                dgates, wh_ref[layer].astype(f32).T, preferred_element_type=f32
+            )
+            dc[layer] = dct * f
+            dwh_ref[layer] += jnp.dot(
+                h_prev.T, dgates, preferred_element_type=f32
+            ).astype(dwh_ref.dtype)
+            if layer == 0:
+                dxp_ref[:, t, :] = dgates.astype(dxp_ref.dtype)
+            else:
+                dh[layer - 1] = dh[layer - 1] + jnp.dot(
+                    dgates, wx_ref[layer - 1].astype(f32).T, preferred_element_type=f32
+                )
+                dwx_ref[layer - 1] += jnp.dot(
+                    below.T, dgates, preferred_element_type=f32
+                ).astype(dwx_ref.dtype)
+                db_ref[layer - 1] += jnp.sum(dgates, axis=0).astype(db_ref.dtype)
+
+
+def _pad_rows(arr, block):
+    r = arr.shape[0]
+    pad = (-r) % block
+    if pad:
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        arr = jnp.pad(arr, widths)
+    return arr, pad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_lstm(x_proj0, wh_stack, wx_stack, b_stack):
+    """Run the fused recurrence; returns ``(hs_top, h_fin, c_fin)``.
+
+    Args:
+      x_proj0: ``(R, T, 4H)`` — layer 0's hoisted input projection
+        (``x @ wx_0 + b_0``), any float dtype.
+      wh_stack: ``(L, H, 4H)`` recurrent weights, all layers.
+      wx_stack: ``(max(L-1, 1), H, 4H)`` input weights of layers >= 1
+        (ignored garbage row allowed when L == 1 so the operand is never
+        zero-sized).
+      b_stack: ``(max(L-1, 1), 4H)`` biases of layers >= 1.
+
+    Returns ``hs_top`` ``(R, T, H)`` (top layer's hidden sequence) plus
+    per-layer final states ``(L, R, H)`` each, matching
+    ``ops.lstm.StackedLSTM``'s return contract.
+    """
+    out, _ = _fused_fwd(x_proj0, wh_stack, wx_stack, b_stack)
+    return out
+
+
+def _run_fwd(x_proj0, wh_stack, wx_stack, b_stack):
+    R, T, four_h = x_proj0.shape
+    L, h_dim, _ = wh_stack.shape
+    dtype = x_proj0.dtype
+    xp, pad = _pad_rows(x_proj0, _BLOCK_R)
+    rp = xp.shape[0]
+    grid = (rp // _BLOCK_R,)
+    kernel = functools.partial(_fwd_kernel, T, L)
+    out, hseq, cseq = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_R, T, four_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((L, h_dim, four_h), lambda i: (0, 0, 0)),
+            pl.BlockSpec(wx_stack.shape, lambda i: (0, 0, 0)),
+            pl.BlockSpec(b_stack.shape, lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_R, T, h_dim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((L, _BLOCK_R, T, h_dim), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((L, _BLOCK_R, T, h_dim), lambda i: (0, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, T, h_dim), dtype),
+            jax.ShapeDtypeStruct((L, rp, T, h_dim), dtype),
+            jax.ShapeDtypeStruct((L, rp, T, h_dim), dtype),
+        ],
+        interpret=not pallas_lstm_available(),
+    )(xp, wh_stack, wx_stack, b_stack)
+    return out, hseq, cseq, pad, R
+
+
+def _fused_fwd(x_proj0, wh_stack, wx_stack, b_stack):
+    out, hseq, cseq, pad, R = _run_fwd(x_proj0, wh_stack, wx_stack, b_stack)
+    h_fin = hseq[:, :R, -1, :]
+    c_fin = cseq[:, :R, -1, :]
+    result = (out[:R], h_fin, c_fin)
+    residuals = (x_proj0, wh_stack, wx_stack, b_stack, hseq, cseq)
+    return result, residuals
+
+
+def _fused_bwd(residuals, cotangents):
+    x_proj0, wh_stack, wx_stack, b_stack, hseq, cseq = residuals
+    g_out, g_hfin, g_cfin = cotangents
+    R, T, four_h = x_proj0.shape
+    L, h_dim, _ = wh_stack.shape
+    dtype = x_proj0.dtype
+
+    xp, _ = _pad_rows(x_proj0, _BLOCK_R)
+    rp = xp.shape[0]
+    gout, _ = _pad_rows(g_out.astype(dtype), _BLOCK_R)
+    # final-state cotangents: (L, R, H) -> row-padded, layer-major blocks
+    ghfin, _ = _pad_rows(jnp.swapaxes(g_hfin.astype(dtype), 0, 1), _BLOCK_R)
+    gcfin, _ = _pad_rows(jnp.swapaxes(g_cfin.astype(dtype), 0, 1), _BLOCK_R)
+    ghfin = jnp.swapaxes(ghfin, 0, 1)
+    gcfin = jnp.swapaxes(gcfin, 0, 1)
+    grid = (rp // _BLOCK_R,)
+    kernel = functools.partial(_bwd_kernel, T, L)
+    f32 = jnp.float32
+    dxp, dwh, dwx, db = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_R, T, four_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((L, h_dim, four_h), lambda i: (0, 0, 0)),
+            pl.BlockSpec(wx_stack.shape, lambda i: (0, 0, 0)),
+            pl.BlockSpec(b_stack.shape, lambda i: (0, 0)),
+            pl.BlockSpec((L, _BLOCK_R, T, h_dim), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((L, _BLOCK_R, T, h_dim), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((_BLOCK_R, T, h_dim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((L, _BLOCK_R, h_dim), lambda i: (0, i, 0)),
+            pl.BlockSpec((L, _BLOCK_R, h_dim), lambda i: (0, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_R, T, four_h), lambda i: (i, 0, 0)),
+            # weight grads: every grid step maps to the same block; the
+            # sequential TPU grid makes read-modify-write accumulation safe
+            pl.BlockSpec((L, h_dim, four_h), lambda i: (0, 0, 0)),
+            pl.BlockSpec(wx_stack.shape, lambda i: (0, 0, 0)),
+            pl.BlockSpec(b_stack.shape, lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, T, four_h), dtype),
+            jax.ShapeDtypeStruct(wh_stack.shape, f32),
+            jax.ShapeDtypeStruct(wx_stack.shape, f32),
+            jax.ShapeDtypeStruct(b_stack.shape, f32),
+        ],
+        interpret=not pallas_lstm_available(),
+    )(xp, wh_stack, wx_stack, b_stack, hseq, cseq, gout, ghfin, gcfin)
+    return (
+        dxp[:R],
+        dwh.astype(wh_stack.dtype),
+        dwx.astype(wx_stack.dtype),
+        db.astype(b_stack.dtype),
+    )
+
+
+fused_lstm.defvjp(_fused_fwd, _fused_bwd)

@@ -6,8 +6,9 @@ points advanced per second of steady-state training step (forward + grad +
 Adam update), on whatever single chip JAX exposes. The record also carries
 ``mfu`` (analytic-FLOPs model utilization vs the chip's bf16 peak — see
 ``stmgcn_tpu/utils/flops.py``) and a ``variants`` table covering
-{fp32, bf16} x {plain scan, tuned fused/unrolled scan} — all numerically
-equivalent schedules of the same step; the headline is the fastest leg.
+{fp32, bf16} x {plain scan, tuned fused/unrolled scan, fused Pallas
+kernel} — all numerically equivalent schedules of the same step; the
+headline is the fastest leg.
 Timing methodology is chained-steps with a single readback fence
 (``stmgcn_tpu.utils.time_chained``): on this image's tunneled TPU backend,
 ``block_until_ready`` does not actually fence and a per-step sync costs a
@@ -48,16 +49,21 @@ BATCH = int(os.environ.get("STMGCN_BENCH_BATCH", 64))
 DTYPE = os.environ.get("STMGCN_BENCH_DTYPE", "both")  # float32 | bfloat16 | both
 WARMUP = int(os.environ.get("STMGCN_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 30))
-# LSTM scan scheduling levers (numerically identical; see ops/lstm.py).
-# By default the bench measures BOTH the plain schedule (scan, unroll=1)
-# and the tuned one (single fused scan over all layers, fully unrolled —
-# 0 means unroll=T); setting either env var replaces the pair with that
-# one custom schedule. An unset var keeps its plain-schedule value so a
-# partial override still means what it always meant.
+# LSTM scheduling levers (numerically identical; see ops/lstm.py and
+# ops/pallas_lstm.py). By default the bench measures THREE schedules: the
+# plain scan (unroll=1), the tuned scan (single fused scan over all
+# layers, fully unrolled — 0 means unroll=T), and the hand-written fused
+# Pallas kernel (backend=pallas; whole T x L recurrence in one kernel
+# pair, VMEM-resident states). Setting any env var replaces the set with
+# that one custom schedule. An unset var keeps its plain-schedule value
+# so a partial override still means what it always meant.
 LSTM_UNROLL = int(os.environ.get("STMGCN_BENCH_LSTM_UNROLL", 1))
 LSTM_FUSED = os.environ.get("STMGCN_BENCH_LSTM_FUSED", "0") == "1"
+LSTM_BACKEND = os.environ.get("STMGCN_BENCH_LSTM_BACKEND", "xla")
 CUSTOM_SCHEDULE = (
-    "STMGCN_BENCH_LSTM_UNROLL" in os.environ or "STMGCN_BENCH_LSTM_FUSED" in os.environ
+    "STMGCN_BENCH_LSTM_UNROLL" in os.environ
+    or "STMGCN_BENCH_LSTM_FUSED" in os.environ
+    or "STMGCN_BENCH_LSTM_BACKEND" in os.environ
 )
 LSTM_HIDDEN, LSTM_LAYERS, GCN_HIDDEN, M_GRAPHS, K_SUPPORTS = 64, 3, 64, 3, 3
 
@@ -68,13 +74,17 @@ def _emit(record: dict) -> None:
     sys.exit(0)
 
 
-def _probe_backend() -> Optional[str]:
+def _probe_backend() -> tuple[Optional[str], Optional[str]]:
     """Probe backend init in a killable child; retry with backoff.
 
     A wedged TPU tunnel can block the first device op indefinitely *inside
     native code* (signal handlers never run), so the probe happens in a
-    child process the parent can time out and kill. Returns None when the
-    backend is healthy, else the final error string.
+    child process the parent can time out and kill. Returns
+    ``(error, backend_name)``: ``(None, "tpu"|"cpu"|...)`` when the
+    backend is healthy (the name is what ``jax.default_backend()``
+    resolves to — a host without the TPU plugin probes *successfully* on
+    CPU, and callers must not mistake that for a chip), else
+    ``(final error string, None)``.
     ``STMGCN_BENCH_WATCHDOG=0`` disables it; any other integer scales the
     first attempt's timeout (later attempts grow: t, 2t, 3t).
     """
@@ -82,22 +92,23 @@ def _probe_backend() -> Optional[str]:
 
     base = int(os.environ.get("STMGCN_BENCH_WATCHDOG", 45))
     if base <= 0:
-        return None
+        return None, None
     probe = (
         "import jax, jax.numpy as jnp; "
-        "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()"
+        "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
+        "print(jax.default_backend())"
     )
     err = "backend probe never ran"
     timeouts = (base, 2 * base, 3 * base)
     for attempt, timeout_s in enumerate(timeouts):
         try:
-            subprocess.run(
+            out = subprocess.run(
                 [sys.executable, "-c", probe],
                 timeout=timeout_s,
                 check=True,
                 capture_output=True,
             )
-            return None
+            return None, out.stdout.decode().strip().splitlines()[-1]
         except subprocess.TimeoutExpired:
             err = f"backend did not initialize within {timeout_s}s (attempt {attempt + 1})"
         except subprocess.CalledProcessError as e:
@@ -105,10 +116,12 @@ def _probe_backend() -> Optional[str]:
         if attempt + 1 < len(timeouts):
             print(f"bench: {err}; retrying", file=sys.stderr)
             time.sleep(2**attempt)
-    return err
+    return err, None
 
 
-def _measure(dtype: str, unroll: int, fused: bool, warmup: int, iters: int) -> dict:
+def _measure(
+    dtype: str, unroll: int, fused: bool, backend: str, warmup: int, iters: int
+) -> dict:
     """Measure the training step at the canonical point, one schedule/dtype.
 
     Methodology: ``time_chained`` — N chained steps, one readback fence at
@@ -147,6 +160,7 @@ def _measure(dtype: str, unroll: int, fused: bool, warmup: int, iters: int) -> d
         gcn_hidden_dim=GCN_HIDDEN,
         lstm_unroll=unroll,
         lstm_fused_scan=fused,
+        lstm_backend=backend,
         dtype=jnp.bfloat16 if dtype == "bfloat16" else None,
     )
     fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
@@ -203,33 +217,46 @@ def main() -> None:
     pinned = os.environ.get("STMGCN_BENCH_PLATFORM")
     if pinned:
         force_host_platform(pinned)
-        probe_err = None
+        probe_err, probed_backend = None, pinned
     else:
-        probe_err = _probe_backend()
+        probe_err, probed_backend = _probe_backend()
     if probe_err is not None:
         # TPU unreachable: measure on the host CPU instead of recording nothing.
         force_host_platform("cpu")
 
     dtypes = ("float32", "bfloat16") if DTYPE == "both" else (DTYPE,)
+    # The pallas leg is only a measurement on a real TPU: anywhere else the
+    # kernel runs in interpret mode (correct but orders of magnitude slow).
+    # Keyed off the *resolved* backend the probe child reported (or the
+    # pinned platform): a host whose probe succeeds on CPU because the TPU
+    # plugin is absent must drop the leg just like a pinned-CPU run.
+    native_tpu = probe_err is None and probed_backend == "tpu"
     if CUSTOM_SCHEDULE:
-        schedules = {"custom": (LSTM_UNROLL, LSTM_FUSED)}
+        schedules = {"custom": (LSTM_UNROLL, LSTM_FUSED, LSTM_BACKEND)}
     else:
-        schedules = {"plain": (1, False), "tuned": (0, True)}
+        schedules = {
+            "plain": (1, False, "xla"),
+            "tuned": (0, True, "xla"),
+        }
+        if native_tpu:
+            schedules["pallas"] = (1, False, "pallas")
     if probe_err is not None:
         # CPU fallback: keep it cheap — but explicitly requested knobs
-        # (dtype, schedule) are honored, not silently replaced
+        # (dtype, schedule) are honored, not silently replaced.
         if "STMGCN_BENCH_DTYPE" not in os.environ:
             dtypes = ("float32",)
         if not CUSTOM_SCHEDULE:
-            schedules = {"plain": (1, False)}
+            schedules = {"plain": (1, False, "xla")}
 
     results = {}
     measure_err = None
     for d in dtypes:
-        for sched, (unroll, fused) in schedules.items():
+        for sched, (unroll, fused, backend) in schedules.items():
             warmup, iters = (1, 3) if probe_err is not None else (WARMUP, ITERS)
             try:
-                results[f"{d}/{sched}"] = _measure(d, unroll, fused, warmup, iters)
+                results[f"{d}/{sched}"] = _measure(
+                    d, unroll, fused, backend, warmup, iters
+                )
             except Exception as e:  # keep surviving legs: one bad leg must
                 measure_err = f"{d}/{sched}: {type(e).__name__}: {e}"  # not void all
                 print(f"bench: measurement failed for {measure_err}", file=sys.stderr)

@@ -140,8 +140,43 @@ def test_pallas_bf16(data):
     params = base.init(jax.random.key(4), data)
     want, _ = base.apply(params, data)
     got, _ = pallas.apply(params, data)
-    # kernel computes cells in f32 (at least as accurate as bf16 scan);
-    # compare loosely in bf16 range
+    # kernel keeps cell elementwise math in f32 (at least as accurate as
+    # the bf16 scan); compare loosely in bf16 range
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=0.05, atol=0.05
     )
+
+
+def test_pallas_bf16_gradients(data):
+    """bf16 backward path: the kernel rounds f32 cotangents/activations to
+    bf16 before each MXU contraction (``_mm``) — new rounding that exists
+    only in bf16, so it gets its own gradient pin at bf16 tolerances
+    (~3 decimal digits, accumulated over T=12 steps x 3 layers)."""
+    base = StackedLSTM(hidden_dim=8, num_layers=3, dtype=jnp.bfloat16)
+    pallas = StackedLSTM(
+        hidden_dim=8, num_layers=3, backend="pallas", dtype=jnp.bfloat16
+    )
+    params = base.init(jax.random.key(5), data)
+
+    def loss(model, p, x):
+        out, finals = model.apply(p, x)
+        extra = sum(jnp.mean(h) + jnp.mean(c) for h, c in finals)
+        return jnp.mean(out[:, -1, :].astype(jnp.float32) ** 2) + 0.1 * extra.astype(
+            jnp.float32
+        )
+
+    g_base = jax.grad(lambda p: loss(base, p, data))(params)
+    g_pallas = jax.grad(lambda p: loss(pallas, p, data))(params)
+    for path, a in jax.tree_util.tree_flatten_with_path(g_pallas)[0]:
+        b = g_base
+        for k in path:
+            b = b[k.key]
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        # relative to the leaf's scale: bf16 has ~2-3 significant digits
+        scale = max(np.abs(b).max(), 1e-3)
+        np.testing.assert_allclose(a, b, atol=0.06 * scale, err_msg=str(path))
+        # and the gradient must genuinely point the same way, not just be
+        # small: cosine similarity over the leaf
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        if denom > 1e-12:
+            assert (a * b).sum() / denom > 0.99, path

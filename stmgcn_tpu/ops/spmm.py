@@ -162,19 +162,23 @@ def _spmm_call(data, idx, x, n, tile, interpret):
     return out[:n, :m]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _spmm_vjp(data, idx, data_t, idx_t, x, n, tile, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _spmm_vjp(data, idx, data_t, idx_t, x, n, tile, interpret, x_dtype):
     return _spmm_call(data, idx, x, n, tile, interpret)
 
 
-def _spmm_fwd(data, idx, data_t, idx_t, x, n, tile, interpret):
+def _spmm_fwd(data, idx, data_t, idx_t, x, n, tile, interpret, x_dtype):
     return _spmm_call(data, idx, x, n, tile, interpret), (data_t, idx_t)
 
 
-def _spmm_bwd(n, tile, interpret, res, g):
+def _spmm_bwd(n, tile, interpret, x_dtype, res, g):
     data_t, idx_t = res
     dx = _spmm_call(data_t, idx_t, g, n, tile, interpret)
-    return (None, None, None, None, dx)
+    # the kernel accumulates f32; the cotangent must come back in the
+    # primal's dtype (passed statically — a traced dtype-carrier residual
+    # would break shard_map's sharding checks) or a bf16 compute path
+    # trips dtype checks upstream
+    return (None, None, None, None, dx.astype(x_dtype))
 
 
 _spmm_vjp.defvjp(_spmm_fwd, _spmm_bwd)
@@ -199,7 +203,10 @@ def spmm(bs: BlockSparse, x: jnp.ndarray, interpret: Optional[bool] = None) -> j
         raise ValueError(f"x has {x.shape[0]} rows, support expects {bs.n}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _spmm_vjp(bs.data, bs.idx, bs.data_t, bs.idx_t, x, bs.n, bs.tile, interpret)
+    return _spmm_vjp(
+        bs.data, bs.idx, bs.data_t, bs.idx_t, x, bs.n, bs.tile, interpret,
+        jnp.dtype(x.dtype).name,
+    )
 
 
 def spmm_dense_reference(mat, x) -> jnp.ndarray:
@@ -410,22 +417,24 @@ def _stack_bwd_call(data_t, idx_t, g, n_rows, n_cols, tile, interpret):
     return out[:n_cols, :m]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _spmm_stack_vjp(data, idx, data_t, idx_t, x, n_rows, n_cols, tile, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _spmm_stack_vjp(data, idx, data_t, idx_t, x, n_rows, n_cols, tile, interpret, x_dtype):
     return _stack_fwd_call(data, idx, x, n_rows, n_cols, tile, interpret)
 
 
-def _spmm_stack_fwd(data, idx, data_t, idx_t, x, n_rows, n_cols, tile, interpret):
+def _spmm_stack_fwd(data, idx, data_t, idx_t, x, n_rows, n_cols, tile, interpret, x_dtype):
     return _stack_fwd_call(data, idx, x, n_rows, n_cols, tile, interpret), (
         data_t,
         idx_t,
     )
 
 
-def _spmm_stack_bwd(n_rows, n_cols, tile, interpret, res, g):
+def _spmm_stack_bwd(n_rows, n_cols, tile, interpret, x_dtype, res, g):
     data_t, idx_t = res
     dx = _stack_bwd_call(data_t, idx_t, g, n_rows, n_cols, tile, interpret)
-    return (None, None, None, None, dx)
+    # f32 kernel accumulation -> cotangent in the primal's dtype (see
+    # _spmm_bwd)
+    return (None, None, None, None, dx.astype(x_dtype))
 
 
 _spmm_stack_vjp.defvjp(_spmm_stack_fwd, _spmm_stack_bwd)
@@ -448,5 +457,5 @@ def spmm_stack(
         interpret = jax.default_backend() != "tpu"
     return _spmm_stack_vjp(
         bss.data, bss.idx, bss.data_t, bss.idx_t, x,
-        bss.n_rows, bss.n_cols, bss.tile, interpret,
+        bss.n_rows, bss.n_cols, bss.tile, interpret, jnp.dtype(x.dtype).name,
     )

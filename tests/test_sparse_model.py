@@ -121,3 +121,29 @@ class TestSparseExperiment:
         sup, modes = route_supports(cfg, build_dataset(cfg))
         assert modes == ("sparse",) * 3
         assert all(isinstance(s, ShardedBlockSparse) for s in sup)
+
+    def test_sparse_bf16_training_step(self):
+        """bf16 compute over the sparse path: the SpMM kernels accumulate
+        f32 and their VJP must return the cotangent in the *primal's*
+        dtype — an f32 dx for a bf16 primal detonated dtype checks at the
+        next slice transpose upstream (found on the scaled preset)."""
+        from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
+        from stmgcn_tpu.train import make_optimizer, make_step_fns
+
+        cfg = preset("scaled")
+        cfg.data.rows = 6
+        cfg.model.sparse = True
+        cfg.train.batch_size = 2
+        cfg.data.n_timesteps = 24 * 7 * 2 + 10
+        cfg.mesh.dp = cfg.mesh.region = 1
+        assert cfg.model.dtype == "bfloat16"  # the preset's point
+        ds = build_dataset(cfg)
+        supports, modes = route_supports(cfg, ds)
+        model = build_model(cfg, ds.n_feats, modes, None)
+        fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
+        batch = next(ds.batches("train", 2, pad_last=True))
+        x, y = jnp.asarray(batch.x), jnp.asarray(batch.y)
+        mask = jnp.ones(len(batch), jnp.float32)
+        params, opt = fns.init(jax.random.key(0), supports, x)
+        _, _, loss = fns.train_step(params, opt, supports, x, y, mask)
+        assert np.isfinite(float(loss))

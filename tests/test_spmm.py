@@ -78,6 +78,30 @@ class TestSpmm:
         np.testing.assert_allclose(np.asarray(g_sparse), np.asarray(g_dense),
                                    rtol=1e-4, atol=1e-4)
 
+    def test_bf16_gradient_dtype_and_values(self):
+        """Single-support path under bf16: the cotangent must come back in
+        the primal's dtype (the kernel accumulates f32; _spmm_bwd casts —
+        the stack path's twin fix is covered by test_sparse_model.py)."""
+        mat = banded_matrix(256, 20)
+        bs = from_dense(mat)
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.standard_normal((256, 32)), jnp.bfloat16)
+        c = jnp.asarray(rng.standard_normal((256, 32)), jnp.bfloat16)
+
+        def loss(x):
+            out = spmm(bs, x).astype(x.dtype)  # callers cast fwd output
+            return jnp.sum((out * c).astype(jnp.float32))
+
+        g = jax.grad(loss)(x)
+        assert g.dtype == jnp.bfloat16
+        g_dense = jax.grad(
+            lambda x: jnp.sum((jnp.asarray(mat, x.dtype) @ x * c).astype(jnp.float32))
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(g_dense, np.float32),
+            rtol=0.05, atol=0.05,
+        )
+
     def test_under_jit_and_value_and_grad(self):
         mat = banded_matrix(128, 6)
         bs = from_dense(mat)

@@ -37,6 +37,8 @@ def __getattr__(name):
         "build_trainer": "stmgcn_tpu.experiment",
         "run": "stmgcn_tpu.experiment",
         "Forecaster": "stmgcn_tpu.inference",
+        "ExportedForecaster": "stmgcn_tpu.export",
+        "export_forecaster": "stmgcn_tpu.export",
         "STMGCN": "stmgcn_tpu.models",
         "Trainer": "stmgcn_tpu.train",
     }

@@ -122,6 +122,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the run into DIR")
     p.add_argument("--resume", action="store_true",
                    help="resume from <out-dir>/latest.ckpt before training")
+    p.add_argument("--export", type=str, default=None, metavar="PATH",
+                   help="after training/testing, write the best checkpoint "
+                        "as a self-contained AOT serving artifact "
+                        "(serialized StableHLO + normalizer; see "
+                        "stmgcn_tpu.export)")
     p.add_argument("--test-only", action="store_true",
                    help="skip training; evaluate <out-dir>/best.ckpt")
     p.add_argument("--print-config", action="store_true",
@@ -253,6 +258,21 @@ def main(argv=None) -> int:
 
     if jax.process_index() == 0:  # one JSON line per job, not per host
         print(json.dumps({"preset": cfg.name, "results": results}))
+
+    # Export last: a failed export must not cost the run its results line.
+    if args.export and jax.process_index() == 0:
+        import os
+
+        from stmgcn_tpu.export import export_forecaster
+        from stmgcn_tpu.inference import Forecaster
+
+        try:
+            fc = Forecaster.from_checkpoint(os.path.join(cfg.train.out_dir, "best.ckpt"))
+            export_forecaster(fc, args.export)
+        except (ValueError, FileNotFoundError) as e:
+            print(f"error: export failed: {e}", file=sys.stderr)
+            return 1
+        print(f"serving artifact written to {args.export}")
     return 0
 
 

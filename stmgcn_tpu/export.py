@@ -1,0 +1,175 @@
+"""Ahead-of-time export: a trained forecaster as one serving artifact.
+
+:class:`~stmgcn_tpu.inference.Forecaster` serves from a checkpoint but
+needs the framework (flax model code, config reconstruction) at load
+time. This module goes one step further down the deployment path the
+reference doesn't have at all (its checkpoints can't even denormalize —
+``Model_Trainer.py:52-53``, SURVEY.md §5.d): ``export_forecaster``
+lowers the jitted forward — **parameters baked in as constants** — to
+serialized StableHLO via :mod:`jax.export` and writes a single file
+carrying the compiled-function bytes plus the normalizer statistics and
+shape contract. ``ExportedForecaster.load`` rebuilds a raw-units
+predictor from that file alone: no model classes, no config, no flax —
+just JAX's export runtime. The batch dimension is exported symbolically,
+so one artifact serves any batch size.
+
+Scope: dense ``(M, K, N, N)`` support stacks (the serving-side
+representation — ``Forecaster`` rebuilds banded/sparse-trained
+checkpoints on one device with dense supports already, PARITY.md §5.h).
+Sparse pytrees are a training-side optimization and are rejected here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+from stmgcn_tpu.data.normalize import normalizer_from_dict
+
+__all__ = ["ExportedForecaster", "export_forecaster"]
+
+_MAGIC = b"STMGX1\n"
+
+
+def _write_blobs(path: str, blobs: list[bytes]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        for blob in blobs:
+            f.write(struct.pack("<Q", len(blob)))
+            f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_blobs(path: str, n: int) -> list[bytes]:
+    with open(path, "rb") as f:
+        if f.read(len(_MAGIC)) != _MAGIC:
+            raise ValueError(f"{path} is not an stmgcn-tpu export artifact")
+        blobs = []
+        for _ in range(n):
+            (size,) = struct.unpack("<Q", f.read(8))
+            blobs.append(f.read(size))
+    return blobs
+
+
+def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
+    """Write ``fc`` (a :class:`~stmgcn_tpu.inference.Forecaster`) to
+    ``path`` as a self-contained serving artifact.
+
+    ``platforms`` lists the backends the artifact must run on (compiled
+    for all of them; JAX picks the matching lowering at call time). The
+    exported program must be pure XLA: a forecaster whose LSTM uses the
+    Pallas kernel backend (TPU-only custom call) is exported through an
+    ``lstm_backend="xla"`` clone of the model — checkpoints are
+    backend-agnostic (same params, same math, equality-tested), so this
+    changes nothing about the numbers. Sparse-trained checkpoints carry a
+    per-branch param layout consuming block-CSR pytrees and are rejected;
+    convert with :func:`stmgcn_tpu.models.to_vmapped_params` and rebuild
+    the model dense first (sparsity is a training-side optimization — a
+    serving artifact bakes dense supports into its signature).
+    """
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    model = fc.model
+    if any(mode != "dense" for mode in model.branch_modes()):
+        raise ValueError(
+            "cannot export a sparse/banded-support model: serving artifacts "
+            "take a dense (M, K, N, N) support stack. Convert the checkpoint "
+            "params with stmgcn_tpu.models.to_vmapped_params and rebuild the "
+            "model with sparse=False / region_strategy='gspmd'."
+        )
+    if model.lstm_backend != "xla":
+        # Pallas lowers to a TPU-only custom call; the scan path is the
+        # same function of the same params (tests/test_pallas_lstm.py)
+        model = dataclasses.replace(model, lstm_backend="xla")
+
+    n_nodes = fc.derived["n_nodes"]
+    input_dim = fc.derived["input_dim"]
+    m = fc.config.model.m_graphs
+    k = model.n_supports
+    params = fc.params
+
+    def fn(supports, history):
+        return model.apply(params, supports, history)
+
+    (b,) = jax_export.symbolic_shape("b")
+    sup_t = jax.ShapeDtypeStruct((m, k, n_nodes, n_nodes), jnp.float32)
+    hist_t = jax.ShapeDtypeStruct((b, fc.seq_len, n_nodes, input_dim), jnp.float32)
+    exported = jax_export.export(jax.jit(fn), platforms=tuple(platforms))(sup_t, hist_t)
+
+    meta = {
+        "version": 1,
+        "platforms": list(platforms),
+        "n_nodes": n_nodes,
+        "input_dim": input_dim,
+        "seq_len": fc.seq_len,
+        "horizon": fc.horizon,
+        "m_graphs": m,
+        "n_supports": k,
+        "normalizer": fc.normalizer.to_dict() if fc.normalizer is not None else None,
+    }
+    _write_blobs(path, [json.dumps(meta).encode("utf-8"), exported.serialize()])
+
+
+class ExportedForecaster:
+    """A serving artifact loaded back into a callable predictor.
+
+    Same raw-units contract as ``Forecaster.predict`` — normalize input,
+    run the baked-in compiled forward, denormalize output — but rebuilt
+    from serialized StableHLO: the framework's model code is not touched.
+    """
+
+    def __init__(self, exported, meta: dict):
+        self._exported = exported
+        self.meta = meta
+        self.normalizer = (
+            normalizer_from_dict(meta["normalizer"]) if meta["normalizer"] else None
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "ExportedForecaster":
+        meta_blob, fn_blob = _read_blobs(path, 2)
+        meta = json.loads(meta_blob.decode("utf-8"))
+        if meta.get("version") != 1:
+            raise ValueError(f"unsupported export version {meta.get('version')!r}")
+        return cls(jax_export.deserialize(fn_blob), meta)
+
+    @property
+    def seq_len(self) -> int:
+        return self.meta["seq_len"]
+
+    @property
+    def horizon(self) -> int:
+        return self.meta["horizon"]
+
+    def predict(self, supports, history, *, normalized: bool = False) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from stmgcn_tpu.inference import serve_predict
+
+        supports = np.asarray(supports, dtype=np.float32)
+        want = (
+            self.meta["m_graphs"],
+            self.meta["n_supports"],
+            self.meta["n_nodes"],
+            self.meta["n_nodes"],
+        )
+        if supports.shape != want:
+            raise ValueError(f"supports must be {want}, got {supports.shape}")
+        expected = (self.meta["seq_len"], self.meta["n_nodes"], self.meta["input_dim"])
+        return serve_predict(
+            lambda h: self._exported.call(jnp.asarray(supports), jnp.asarray(h)),
+            self.normalizer,
+            expected,
+            history,
+            normalized,
+        )

@@ -28,6 +28,26 @@ from stmgcn_tpu.train.checkpoint import load_checkpoint
 __all__ = ["Forecaster"]
 
 
+def serve_predict(call, normalizer, expected, history, normalized: bool) -> np.ndarray:
+    """Shared raw-units serving flow: validate → normalize → call →
+    denormalize. Used by both :class:`Forecaster` and
+    :class:`stmgcn_tpu.export.ExportedForecaster` so the two contracts
+    cannot drift. ``expected`` is ``(seq_len, n_nodes, input_dim)``;
+    ``call`` maps a normalized ``(B, T, N, C)`` array to predictions."""
+    history = np.asarray(history, dtype=np.float32)
+    if history.ndim != 4 or history.shape[1:] != tuple(expected):
+        raise ValueError(
+            f"history must be (B, seq_len={expected[0]}, n_nodes={expected[1]}, "
+            f"n_feats={expected[2]}) for this model, got {history.shape}"
+        )
+    if not normalized and normalizer is not None:
+        history = normalizer.transform(history)
+    pred = np.asarray(call(history))
+    if normalizer is not None:
+        pred = normalizer.inverse(pred)
+    return pred
+
+
 class Forecaster:
     """A trained ST-MGCN ready to forecast from raw demand history."""
 
@@ -72,17 +92,11 @@ class Forecaster:
         built from the city's graphs. Returns raw-unit forecasts of shape
         ``(B, N, C)`` or ``(B, H, N, C)``.
         """
-        history = np.asarray(history, dtype=np.float32)
         expected = (self.seq_len, self.derived["n_nodes"], self.derived["input_dim"])
-        if history.ndim != 4 or history.shape[1:] != expected:
-            raise ValueError(
-                f"history must be (B, seq_len={expected[0]}, n_nodes={expected[1]}, "
-                f"n_feats={expected[2]}) for this checkpoint, got {history.shape}"
-            )
-        if not normalized and self.normalizer is not None:
-            history = self.normalizer.transform(history)
-        pred = self._apply(self.params, supports, jnp.asarray(history))
-        pred = np.asarray(pred)
-        if self.normalizer is not None:
-            pred = self.normalizer.inverse(pred)
-        return pred
+        return serve_predict(
+            lambda h: self._apply(self.params, supports, jnp.asarray(h)),
+            self.normalizer,
+            expected,
+            history,
+            normalized,
+        )

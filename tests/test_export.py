@@ -1,0 +1,130 @@
+"""AOT export round-trip: serialized StableHLO artifact == live Forecaster.
+
+Train-free: a freshly-initialized flagship plus a fitted normalizer is
+enough to pin the contract (baked params, symbolic batch, normalize →
+call → denormalize). The loaded side must not need the model code, so
+the round-trip goes through the file, not the objects.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.data import DemandDataset, MinMaxNormalizer, WindowSpec, synthetic_dataset
+from stmgcn_tpu.experiment import build_model
+from stmgcn_tpu.export import ExportedForecaster, export_forecaster
+from stmgcn_tpu.inference import Forecaster
+from stmgcn_tpu.ops import SupportConfig
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = preset("smoke")
+    cfg.data.rows = 3
+    data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 40, seed=0)
+    ds = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    supports = np.asarray(
+        SupportConfig(cfg.model.kernel_type, cfg.model.K).build_all(ds.adjs.values()),
+        np.float32,
+    )[: cfg.model.m_graphs]
+    model = build_model(cfg, ds.n_feats)
+    x = jnp.zeros((2, cfg.data.seq_len, ds.n_nodes, ds.n_feats), jnp.float32)
+    params = model.init(jax.random.key(0), jnp.asarray(supports), x)
+    norm = MinMaxNormalizer.fit(np.asarray(data.demand))
+    fc = Forecaster(
+        model, params, norm, cfg, {"input_dim": ds.n_feats, "n_nodes": ds.n_nodes}
+    )
+    return fc, supports, ds
+
+
+def test_export_roundtrip_matches_forecaster(setup, tmp_path):
+    fc, supports, ds = setup
+    path = str(tmp_path / "model.stmgx")
+    export_forecaster(fc, path, platforms=("cpu",))
+
+    loaded = ExportedForecaster.load(path)
+    rng = np.random.default_rng(1)
+    hist = rng.uniform(0, 50, (4, fc.seq_len, ds.n_nodes, ds.n_feats)).astype(
+        np.float32
+    )
+    np.testing.assert_allclose(
+        loaded.predict(supports, hist),
+        fc.predict(supports, hist),
+        rtol=1e-5,
+        atol=1e-4,
+    )
+
+
+def test_export_symbolic_batch(setup, tmp_path):
+    """One artifact serves multiple batch sizes (symbolic batch dim)."""
+    fc, supports, ds = setup
+    path = str(tmp_path / "model.stmgx")
+    export_forecaster(fc, path, platforms=("cpu",))
+    loaded = ExportedForecaster.load(path)
+    for b in (1, 3, 7):
+        out = loaded.predict(
+            supports, np.ones((b, fc.seq_len, ds.n_nodes, ds.n_feats), np.float32)
+        )
+        assert out.shape[0] == b and np.isfinite(out).all()
+
+
+def test_export_validates_shapes(setup, tmp_path):
+    fc, supports, ds = setup
+    path = str(tmp_path / "model.stmgx")
+    export_forecaster(fc, path, platforms=("cpu",))
+    loaded = ExportedForecaster.load(path)
+    with pytest.raises(ValueError, match="history must be"):
+        loaded.predict(supports, np.ones((2, 99, ds.n_nodes, ds.n_feats), np.float32))
+    with pytest.raises(ValueError, match="supports must be"):
+        loaded.predict(supports[:, :1], np.ones((2, fc.seq_len, ds.n_nodes, ds.n_feats), np.float32))
+
+
+def test_export_rejects_sparse_model(setup, tmp_path):
+    """Sparse-trained models are cleanly rejected (serving artifacts bake a
+    dense support signature), not left to die in tracing."""
+    import dataclasses
+
+    fc, supports, ds = setup
+    sparse_fc = Forecaster(
+        dataclasses.replace(fc.model, sparse=True),
+        fc.params,
+        fc.normalizer,
+        fc.config,
+        fc.derived,
+    )
+    with pytest.raises(ValueError, match="cannot export a sparse"):
+        export_forecaster(sparse_fc, str(tmp_path / "m.stmgx"), platforms=("cpu",))
+
+
+def test_export_pallas_backend_via_xla_clone(setup, tmp_path):
+    """A pallas-backend forecaster exports through an xla clone of the same
+    params (the kernel is a TPU-only custom call; the scan path is the
+    same function — tests/test_pallas_lstm.py) and matches the xla export."""
+    import dataclasses
+
+    fc, supports, ds = setup
+    pallas_fc = Forecaster(
+        dataclasses.replace(fc.model, lstm_backend="pallas"),
+        fc.params,
+        fc.normalizer,
+        fc.config,
+        fc.derived,
+    )
+    path = str(tmp_path / "pallas.stmgx")
+    export_forecaster(pallas_fc, path, platforms=("cpu",))
+    hist = np.ones((2, fc.seq_len, ds.n_nodes, ds.n_feats), np.float32)
+    np.testing.assert_allclose(
+        ExportedForecaster.load(path).predict(supports, hist),
+        fc.predict(supports, hist),
+        rtol=1e-5,
+        atol=1e-4,
+    )
+
+
+def test_export_rejects_bad_file(tmp_path):
+    p = tmp_path / "junk.stmgx"
+    p.write_bytes(b"not an artifact")
+    with pytest.raises(ValueError, match="not an stmgcn-tpu export artifact"):
+        ExportedForecaster.load(str(p))

@@ -118,6 +118,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "TPU pods auto-discover the coordinator)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans (fail fast at the op producing NaN)")
+    p.add_argument("--checkify", choices=("nan", "index", "float", "all"),
+                   default=None, dest="checks",
+                   help="functional sanitizer on the train/eval steps "
+                        "(jax.experimental.checkify): fails at the step "
+                        "producing the bad value (nan: NaNs; index: OOB "
+                        "gathers/scatters; float: nan+div0; all: "
+                        "everything), works under jit+donation on TPU; "
+                        "costs a device sync per step")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
     p.add_argument("--resume", action="store_true",
@@ -162,6 +170,7 @@ def config_from_args(args) -> "ExperimentConfig":
         ("epochs", "epochs"), ("batch_size", "batch_size"), ("lr", "lr"),
         ("weight_decay", "weight_decay"), ("loss", "loss"),
         ("patience", "patience"), ("top_k", "top_k"), ("seed", "seed"),
+        ("checks", "checks"),
         ("out_dir", "out_dir"), ("data_placement", "data_placement"),
     ]:
         val = getattr(args, field)
@@ -260,19 +269,32 @@ def main(argv=None) -> int:
         print(json.dumps({"preset": cfg.name, "results": results}))
 
     # Export last: a failed export must not cost the run its results line.
-    if args.export and jax.process_index() == 0:
-        import os
+    if args.export:
+        ok = True
+        if jax.process_index() == 0:
+            import os
 
-        from stmgcn_tpu.export import export_forecaster
-        from stmgcn_tpu.inference import Forecaster
+            from stmgcn_tpu.export import export_forecaster
+            from stmgcn_tpu.inference import Forecaster
 
-        try:
-            fc = Forecaster.from_checkpoint(os.path.join(cfg.train.out_dir, "best.ckpt"))
-            export_forecaster(fc, args.export)
-        except (ValueError, FileNotFoundError) as e:
-            print(f"error: export failed: {e}", file=sys.stderr)
+            try:
+                fc = Forecaster.from_checkpoint(
+                    os.path.join(cfg.train.out_dir, "best.ckpt")
+                )
+                export_forecaster(fc, args.export)
+                print(f"serving artifact written to {args.export}")
+            except (ValueError, FileNotFoundError) as e:
+                print(f"error: export failed: {e}", file=sys.stderr)
+                ok = False
+        if jax.process_count() > 1:
+            # every host must exit with the same code — a launcher
+            # aggregating per-host codes must see the failure everywhere
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            ok = bool(multihost_utils.broadcast_one_to_all(np.asarray(ok)))
+        if not ok:
             return 1
-        print(f"serving artifact written to {args.export}")
     return 0
 
 

@@ -116,6 +116,10 @@ class TrainConfig:
     lr: float = 2e-3
     weight_decay: float = 1e-4
     loss: str = "mse"
+    #: functional sanitizer (jax.experimental.checkify) on the train/eval
+    #: steps: None | "nan" | "index" | "float" | "all" — fails at the step
+    #: producing the bad value, with a device sync per step (debug tool)
+    checks: Optional[str] = None
     patience: int = 10
     top_k: int = 1  # best improvement snapshots kept alongside best/latest
     shuffle: bool = False  # reference parity (Data_Container.py:122)

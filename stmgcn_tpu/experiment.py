@@ -324,6 +324,7 @@ def build_trainer(
         lr=t.lr,
         weight_decay=t.weight_decay,
         loss=t.loss,
+        checks=t.checks,
         n_epochs=t.epochs,
         batch_size=t.batch_size,
         patience=t.patience,

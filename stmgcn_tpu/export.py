@@ -9,9 +9,11 @@ lowers the jitted forward — **parameters baked in as constants** — to
 serialized StableHLO via :mod:`jax.export` and writes a single file
 carrying the compiled-function bytes plus the normalizer statistics and
 shape contract. ``ExportedForecaster.load`` rebuilds a raw-units
-predictor from that file alone: no model classes, no config, no flax —
-just JAX's export runtime. The batch dimension is exported symbolically,
-so one artifact serves any batch size.
+predictor from that file alone: no model classes, no config machinery,
+no flax — just JAX's export runtime plus the numpy-only data layer
+(normalizer statistics) and :mod:`stmgcn_tpu.serving`. The batch
+dimension is exported symbolically, so one artifact serves any batch
+size.
 
 Scope: dense ``(M, K, N, N)`` support stacks (the serving-side
 representation — ``Forecaster`` rebuilds banded/sparse-trained
@@ -30,6 +32,7 @@ import numpy as np
 from jax import export as jax_export
 
 from stmgcn_tpu.data.normalize import normalizer_from_dict
+from stmgcn_tpu.serving import serve_predict
 
 __all__ = ["ExportedForecaster", "export_forecaster"]
 
@@ -54,8 +57,14 @@ def _read_blobs(path: str, n: int) -> list[bytes]:
             raise ValueError(f"{path} is not an stmgcn-tpu export artifact")
         blobs = []
         for _ in range(n):
-            (size,) = struct.unpack("<Q", f.read(8))
-            blobs.append(f.read(size))
+            header = f.read(8)
+            if len(header) != 8:
+                raise ValueError(f"truncated export artifact: {path}")
+            (size,) = struct.unpack("<Q", header)
+            blob = f.read(size)
+            if len(blob) != size:
+                raise ValueError(f"truncated export artifact: {path}")
+            blobs.append(blob)
     return blobs
 
 
@@ -130,6 +139,8 @@ class ExportedForecaster:
 
     def __init__(self, exported, meta: dict):
         self._exported = exported
+        # jit the call once: Exported.call re-traces per invocation
+        self._call = jax.jit(exported.call)
         self.meta = meta
         self.normalizer = (
             normalizer_from_dict(meta["normalizer"]) if meta["normalizer"] else None
@@ -154,8 +165,6 @@ class ExportedForecaster:
     def predict(self, supports, history, *, normalized: bool = False) -> np.ndarray:
         import jax.numpy as jnp
 
-        from stmgcn_tpu.inference import serve_predict
-
         supports = np.asarray(supports, dtype=np.float32)
         want = (
             self.meta["m_graphs"],
@@ -167,7 +176,7 @@ class ExportedForecaster:
             raise ValueError(f"supports must be {want}, got {supports.shape}")
         expected = (self.meta["seq_len"], self.meta["n_nodes"], self.meta["input_dim"])
         return serve_predict(
-            lambda h: self._exported.call(jnp.asarray(supports), jnp.asarray(h)),
+            lambda h: self._call(jnp.asarray(supports), jnp.asarray(h)),
             self.normalizer,
             expected,
             history,

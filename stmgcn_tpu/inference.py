@@ -22,30 +22,11 @@ import numpy as np
 
 from stmgcn_tpu.config import ExperimentConfig
 from stmgcn_tpu.data.normalize import normalizer_from_dict
+from stmgcn_tpu.serving import serve_predict
 from stmgcn_tpu.experiment import build_model
 from stmgcn_tpu.train.checkpoint import load_checkpoint
 
 __all__ = ["Forecaster"]
-
-
-def serve_predict(call, normalizer, expected, history, normalized: bool) -> np.ndarray:
-    """Shared raw-units serving flow: validate → normalize → call →
-    denormalize. Used by both :class:`Forecaster` and
-    :class:`stmgcn_tpu.export.ExportedForecaster` so the two contracts
-    cannot drift. ``expected`` is ``(seq_len, n_nodes, input_dim)``;
-    ``call`` maps a normalized ``(B, T, N, C)`` array to predictions."""
-    history = np.asarray(history, dtype=np.float32)
-    if history.ndim != 4 or history.shape[1:] != tuple(expected):
-        raise ValueError(
-            f"history must be (B, seq_len={expected[0]}, n_nodes={expected[1]}, "
-            f"n_feats={expected[2]}) for this model, got {history.shape}"
-        )
-    if not normalized and normalizer is not None:
-        history = normalizer.transform(history)
-    pred = np.asarray(call(history))
-    if normalizer is not None:
-        pred = normalizer.inverse(pred)
-    return pred
 
 
 class Forecaster:

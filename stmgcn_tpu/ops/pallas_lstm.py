@@ -52,7 +52,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_lstm", "pallas_lstm_available"]
 

@@ -1,0 +1,32 @@
+"""Framework-free serving glue shared by live and exported predictors.
+
+Deliberately imports nothing but numpy: :class:`stmgcn_tpu.export
+.ExportedForecaster` promises to serve without the model stack (no flax,
+no config machinery), and :class:`stmgcn_tpu.inference.Forecaster` pulls
+the full framework — this module is the piece both can share so their
+raw-units contracts cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["serve_predict"]
+
+
+def serve_predict(call, normalizer, expected, history, normalized: bool) -> np.ndarray:
+    """Shared raw-units serving flow: validate → normalize → call →
+    denormalize. ``expected`` is ``(seq_len, n_nodes, input_dim)``;
+    ``call`` maps a normalized ``(B, T, N, C)`` array to predictions."""
+    history = np.asarray(history, dtype=np.float32)
+    if history.ndim != 4 or history.shape[1:] != tuple(expected):
+        raise ValueError(
+            f"history must be (B, seq_len={expected[0]}, n_nodes={expected[1]}, "
+            f"n_feats={expected[2]}) for this model, got {history.shape}"
+        )
+    if not normalized and normalizer is not None:
+        history = normalizer.transform(history)
+    pred = np.asarray(call(history))
+    if normalizer is not None:
+        pred = normalizer.inverse(pred)
+    return pred

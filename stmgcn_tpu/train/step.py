@@ -60,10 +60,15 @@ class StepFns:
     eval_step: Callable  # (params, supports, x, y, mask) -> (loss, pred)
 
 
+#: checkify error-set names accepted by ``make_step_fns(checks=...)``
+CHECK_SETS = ("nan", "index", "float", "all")
+
+
 def make_step_fns(
     model,
     optimizer: optax.GradientTransformation,
     loss: str = "mse",
+    checks: str | None = None,
 ) -> StepFns:
     """Build jitted init/train/eval steps for a flax model.
 
@@ -72,9 +77,23 @@ def make_step_fns(
     (sample x real-node); the loss is the mean over real elements only, so
     padded tail batches and padded nodes yield exactly the loss of the
     unpadded equivalent.
+
+    ``checks`` enables functional sanitizing via ``jax.experimental
+    .checkify`` — the in-jit analogue of the sanitizers the reference
+    has no counterpart for (SURVEY.md §5.b): ``"nan"`` traps NaN
+    production, ``"index"`` out-of-bounds gathers/scatters, ``"float"``
+    is nan + division-by-zero (NOT index — jax's ``float_checks`` does
+    not include it), ``"all"`` is everything plus user ``checkify.check``
+    calls.
+    The checked step raises ``JaxRuntimeError`` at the failing step with
+    the op's location. Debug tool: error flags are fetched per step, so
+    it costs a device sync per call — unlike ``jax_debug_nans`` it works
+    under jit *with* donation and on TPU without recompiling per op.
     """
     if loss not in LOSSES:
         raise ValueError(f"loss must be one of {LOSSES}, got {loss!r}")
+    if checks is not None and checks not in CHECK_SETS:
+        raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
     def loss_fn(params, supports, x, y, mask):
         pred = model.apply(params, supports, x)
@@ -107,8 +126,32 @@ def make_step_fns(
 
     # init is jitted too: eager flax init dispatches hundreds of tiny ops,
     # which is pathologically slow on remote-tunneled TPU backends.
-    return StepFns(
-        init=jax.jit(init),
-        train_step=jax.jit(train_step, donate_argnums=(0, 1)),
-        eval_step=jax.jit(eval_step),
-    )
+    if checks is None:
+        return StepFns(
+            init=jax.jit(init),
+            train_step=jax.jit(train_step, donate_argnums=(0, 1)),
+            eval_step=jax.jit(eval_step),
+        )
+
+    from jax.experimental import checkify
+
+    errset = {
+        "nan": checkify.nan_checks,
+        "index": checkify.index_checks,
+        "float": checkify.float_checks,  # nan + div (no index checks)
+        "all": checkify.all_checks,
+    }[checks]
+    ck_train = jax.jit(checkify.checkify(train_step, errors=errset), donate_argnums=(0, 1))
+    ck_eval = jax.jit(checkify.checkify(eval_step, errors=errset))
+
+    def checked_train(params, opt_state, supports, x, y, mask):
+        err, out = ck_train(params, opt_state, supports, x, y, mask)
+        checkify.check_error(err)  # device sync; raises at the failing step
+        return out
+
+    def checked_eval(params, supports, x, y, mask):
+        err, out = ck_eval(params, supports, x, y, mask)
+        checkify.check_error(err)
+        return out
+
+    return StepFns(init=jax.jit(init), train_step=checked_train, eval_step=checked_eval)

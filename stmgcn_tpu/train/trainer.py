@@ -116,6 +116,7 @@ class Trainer:
         lr: float = 2e-3,
         weight_decay: float = 1e-4,
         loss: str = "mse",
+        checks: Optional[str] = None,
         n_epochs: int = 100,
         batch_size: int = 32,
         patience: int = 10,
@@ -210,7 +211,9 @@ class Trainer:
                     f"the {mode!r} split is empty — adjust split fractions/dates "
                     "or provide more data"
                 )
-        self.step_fns = make_step_fns(model, make_optimizer(lr, weight_decay), loss)
+        self.step_fns = make_step_fns(
+            model, make_optimizer(lr, weight_decay), loss, checks=checks
+        )
         example = next(dataset.batches("train", batch_size, pad_last=True))
         example_x, _, _ = self._place_batch(example, "train")  # node-padded when needed
         self.params, self.opt_state = self.step_fns.init(

@@ -1,0 +1,69 @@
+"""The checkify sanitizer (SURVEY.md §5.b's in-jit analogue): clean runs
+are numerically untouched; a poisoned input fails AT the step with a NaN
+diagnostic instead of silently corrupting training state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.train import make_optimizer, make_step_fns
+
+
+@pytest.fixture(scope="module")
+def setup():
+    data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 40, seed=0)
+    ds = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    supports = jnp.asarray(
+        SupportConfig("chebyshev", 1).build_all(ds.adjs.values())
+    )
+    model = STMGCN(
+        m_graphs=3, n_supports=2, seq_len=5, input_dim=ds.n_feats,
+        lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8,
+    )
+    batch = next(ds.batches("train", 4, pad_last=True))
+    x, y = jnp.asarray(batch.x), jnp.asarray(batch.y)
+    mask = jnp.ones(4, jnp.float32)
+    return model, supports, x, y, mask
+
+
+def test_checked_step_matches_unchecked(setup):
+    model, supports, x, y, mask = setup
+    plain = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
+    checked = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse", checks="nan")
+    p0, o0 = plain.init(jax.random.key(0), supports, x)
+    p1, o1 = checked.init(jax.random.key(0), supports, x)
+    _, _, l0 = plain.train_step(p0, o0, supports, x, y, mask)
+    _, _, l1 = checked.train_step(p1, o1, supports, x, y, mask)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+
+
+def test_checked_step_traps_nan(setup):
+    model, supports, x, y, mask = setup
+    checked = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse", checks="nan")
+    params, opt = checked.init(jax.random.key(0), supports, x)
+    bad_x = x.at[0, 0, 0, 0].set(jnp.nan)
+    with pytest.raises(Exception, match="nan"):
+        out = checked.train_step(params, opt, supports, bad_x, y, mask)
+        jax.block_until_ready(out)
+
+
+def test_checked_eval_traps_and_clean_passes(setup):
+    model, supports, x, y, mask = setup
+    checked = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse", checks="float")
+    params, _ = checked.init(jax.random.key(0), supports, x)
+    loss, _ = checked.eval_step(params, supports, x, y, mask)
+    assert np.isfinite(float(loss))
+    with pytest.raises(Exception, match="nan"):
+        out = checked.eval_step(params, supports, x.at[0].set(jnp.nan), y, mask)
+        jax.block_until_ready(out)
+
+
+def test_invalid_checks_name_rejected(setup):
+    model, *_ = setup
+    with pytest.raises(ValueError, match="checks must be one of"):
+        make_step_fns(model, make_optimizer(2e-3, 0.0), "mse", checks="everything")

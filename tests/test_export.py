@@ -123,6 +123,29 @@ def test_export_pallas_backend_via_xla_clone(setup, tmp_path):
     )
 
 
+def test_export_module_is_lean():
+    """The serving-side module must not pull the model stack — that's the
+    point of the artifact (load + predict without flax/optax/models)."""
+    import subprocess
+    import sys as _sys
+
+    out = subprocess.run(
+        [
+            _sys.executable,
+            "-c",
+            "import sys; import stmgcn_tpu.export; "
+            "heavy = [m for m in sys.modules if m == 'flax' "
+            "or m.startswith(('flax.', 'optax', 'stmgcn_tpu.models', "
+            "'stmgcn_tpu.experiment', 'stmgcn_tpu.train'))]; "
+            "print(','.join(heavy) or 'LEAN')",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.stdout.strip().splitlines()[-1] == "LEAN", out.stdout + out.stderr
+
+
 def test_export_rejects_bad_file(tmp_path):
     p = tmp_path / "junk.stmgx"
     p.write_bytes(b"not an artifact")

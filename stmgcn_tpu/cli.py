@@ -283,8 +283,10 @@ def main(argv=None) -> int:
                 )
                 export_forecaster(fc, args.export)
                 print(f"serving artifact written to {args.export}")
-            except (ValueError, FileNotFoundError) as e:
-                print(f"error: export failed: {e}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — host 0 must reach the
+                # broadcast below no matter how export dies, or every other
+                # host blocks forever in the collective
+                print(f"error: export failed: {type(e).__name__}: {e}", file=sys.stderr)
                 ok = False
         if jax.process_count() > 1:
             # every host must exit with the same code — a launcher

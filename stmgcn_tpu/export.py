@@ -15,10 +15,12 @@ no flax — just JAX's export runtime plus the numpy-only data layer
 dimension is exported symbolically, so one artifact serves any batch
 size.
 
-Scope: dense ``(M, K, N, N)`` support stacks (the serving-side
-representation — ``Forecaster`` rebuilds banded/sparse-trained
-checkpoints on one device with dense supports already, PARITY.md §5.h).
-Sparse pytrees are a training-side optimization and are rejected here.
+Scope: artifacts always take dense ``(M, K, N, N)`` support stacks (the
+serving-side representation). Sparse/banded-trained checkpoints export
+transparently: their per-branch param layout is restacked to the dense
+vmapped layout (``models.to_vmapped_params``) and the model rebuilt
+dense — sparsity is a training-side optimization, not part of the
+serving contract.
 """
 
 from __future__ import annotations
@@ -78,24 +80,36 @@ def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
     Pallas kernel backend (TPU-only custom call) is exported through an
     ``lstm_backend="xla"`` clone of the model — checkpoints are
     backend-agnostic (same params, same math, equality-tested), so this
-    changes nothing about the numbers. Sparse-trained checkpoints carry a
-    per-branch param layout consuming block-CSR pytrees and are rejected;
-    convert with :func:`stmgcn_tpu.models.to_vmapped_params` and rebuild
-    the model dense first (sparsity is a training-side optimization — a
-    serving artifact bakes dense supports into its signature).
+    changes nothing about the numbers. Sparse/banded-trained checkpoints
+    are restacked to the dense vmapped layout automatically (see the
+    module docstring).
     """
     import dataclasses
 
     import jax.numpy as jnp
 
     model = fc.model
-    if any(mode != "dense" for mode in model.branch_modes()):
-        raise ValueError(
-            "cannot export a sparse/banded-support model: serving artifacts "
-            "take a dense (M, K, N, N) support stack. Convert the checkpoint "
-            "params with stmgcn_tpu.models.to_vmapped_params and rebuild the "
-            "model with sparse=False / region_strategy='gspmd'."
+    params = fc.params
+    m = fc.config.model.m_graphs
+    if any(mode != "dense" for mode in model.branch_modes()) or not model.vmap_branches:
+        # Sparse/banded-trained (or explicitly looped) models use the
+        # per-branch param layout and consume block-CSR/strip pytrees —
+        # training-side representations. The serving artifact bakes a
+        # dense support signature, so rebuild as the dense vmapped model
+        # and restack the params (same modules, same shapes — module
+        # names are explicit and mode-independent; round-trip +
+        # forward-equality pinned in tests/test_param_layouts.py).
+        from stmgcn_tpu.models import to_vmapped_params
+
+        model = dataclasses.replace(
+            model,
+            sparse=False,
+            support_modes=None,
+            shard_spec=None,
+            vmap_branches=True,
+            n_real_nodes=None,
         )
+        params = to_vmapped_params(params, m)
     if model.lstm_backend != "xla":
         # Pallas lowers to a TPU-only custom call; the scan path is the
         # same function of the same params (tests/test_pallas_lstm.py)
@@ -103,9 +117,7 @@ def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
 
     n_nodes = fc.derived["n_nodes"]
     input_dim = fc.derived["input_dim"]
-    m = fc.config.model.m_graphs
     k = model.n_supports
-    params = fc.params
 
     def fn(supports, history):
         return model.apply(params, supports, history)

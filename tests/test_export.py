@@ -81,21 +81,34 @@ def test_export_validates_shapes(setup, tmp_path):
         loaded.predict(supports[:, :1], np.ones((2, fc.seq_len, ds.n_nodes, ds.n_feats), np.float32))
 
 
-def test_export_rejects_sparse_model(setup, tmp_path):
-    """Sparse-trained models are cleanly rejected (serving artifacts bake a
-    dense support signature), not left to die in tracing."""
+def test_export_converts_sparse_checkpoint(setup, tmp_path):
+    """A sparse-trained checkpoint (per-branch looped param layout) exports
+    transparently: params are restacked to the dense vmapped layout and the
+    artifact matches the dense model on the same weights."""
     import dataclasses
 
+    import jax as _jax
+
+    from stmgcn_tpu.models import to_looped_params
+
     fc, supports, ds = setup
+    looped_params = to_looped_params(fc.params, fc.config.model.m_graphs)
     sparse_fc = Forecaster(
         dataclasses.replace(fc.model, sparse=True),
-        fc.params,
+        _jax.tree.map(jnp.asarray, looped_params),
         fc.normalizer,
         fc.config,
         fc.derived,
     )
-    with pytest.raises(ValueError, match="cannot export a sparse"):
-        export_forecaster(sparse_fc, str(tmp_path / "m.stmgx"), platforms=("cpu",))
+    path = str(tmp_path / "m.stmgx")
+    export_forecaster(sparse_fc, path, platforms=("cpu",))
+    hist = np.ones((2, fc.seq_len, ds.n_nodes, ds.n_feats), np.float32)
+    np.testing.assert_allclose(
+        ExportedForecaster.load(path).predict(supports, hist),
+        fc.predict(supports, hist),
+        rtol=1e-5,
+        atol=1e-4,
+    )
 
 
 def test_export_pallas_backend_via_xla_clone(setup, tmp_path):

@@ -230,6 +230,13 @@ def main() -> None:
     # Keyed off the *resolved* backend the probe child reported (or the
     # pinned platform): a host whose probe succeeds on CPU because the TPU
     # plugin is absent must drop the leg just like a pinned-CPU run.
+    if probed_backend is None and probe_err is None:
+        # Watchdog disabled (STMGCN_BENCH_WATCHDOG=0): no probe child ran,
+        # so resolve the backend in-process — disabling the watchdog must
+        # not change which schedules get measured on a real TPU.
+        import jax
+
+        probed_backend = jax.default_backend()
     native_tpu = probe_err is None and probed_backend == "tpu"
     if CUSTOM_SCHEDULE:
         schedules = {"custom": (LSTM_UNROLL, LSTM_FUSED, LSTM_BACKEND)}
@@ -241,12 +248,11 @@ def main() -> None:
         if native_tpu:
             schedules["pallas"] = (1, False, "pallas")
     if probe_err is not None:
-        # CPU fallback: keep it cheap — but explicitly requested knobs
-        # (dtype, schedule) are honored, not silently replaced.
+        # CPU fallback: fp32 only (unless asked), but keep BOTH XLA
+        # schedules — recording only the untuned leg made round 2's
+        # fallback record understate even the CPU capability.
         if "STMGCN_BENCH_DTYPE" not in os.environ:
             dtypes = ("float32",)
-        if not CUSTOM_SCHEDULE:
-            schedules = {"plain": (1, False, "xla")}
 
     results = {}
     measure_err = None
@@ -338,6 +344,37 @@ def main() -> None:
         record["error"] = probe_err
     elif measure_err is not None:
         record["error"] = measure_err
+
+    # Evidence persistence: a successful on-chip measurement is written to
+    # benchmarks/tpu_last_good.json so a later wedged tunnel cannot erase
+    # the round's TPU numbers; any non-TPU record carries the last good
+    # on-chip table inline (with its own timestamp + device provenance).
+    last_good_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "tpu_last_good.json"
+    )
+    if native_tpu and results and measure_err is None:
+        # only a fully-clean on-chip table becomes canonical evidence — a
+        # run with failed legs must not overwrite the last good one
+        snapshot = dict(record)
+        snapshot["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        snapshot["operating_point"] = {
+            "rows": ROWS,
+            "batch": BATCH,
+            "seq_len": SERIAL + DAILY + WEEKLY,
+            "warmup": WARMUP,
+            "iters": ITERS,
+        }
+        try:
+            with open(last_good_path, "w") as f:
+                json.dump(snapshot, f, indent=1)
+        except OSError as e:  # never let evidence-keeping break the record
+            print(f"bench: could not persist last-good: {e}", file=sys.stderr)
+    elif os.path.exists(last_good_path):
+        try:
+            with open(last_good_path) as f:
+                record["last_good_tpu"] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench: could not read last-good: {e}", file=sys.stderr)
     _emit(record)
 
 

@@ -61,6 +61,11 @@ class StackedLSTM(nn.Module):
 
     hidden_dim: int
     num_layers: int = 1
+    #: rematerialize scan steps in the backward pass (XLA schedules). The
+    #: pallas backend is *always* rematerializing — its backward kernel
+    #: recomputes all gate activations from the saved (h, c) sequences
+    #: rather than storing them (ops/pallas_lstm.py) — so ``remat`` is
+    #: satisfied by construction there and the flag changes nothing.
     remat: bool = False
     #: scan steps unrolled per iteration (1 = plain scan; 0 = unroll the
     #: whole sequence — the fastest schedule measured on TPU v5e at the
@@ -105,6 +110,15 @@ class StackedLSTM(nn.Module):
     ) -> tuple[jnp.ndarray, list]:
         if self.backend not in ("xla", "pallas"):
             raise ValueError(f"backend must be xla|pallas, got {self.backend!r}")
+        if self.backend == "pallas" and (self.fused_scan or self.unroll != 1):
+            # These knobs schedule the XLA scan; silently running the
+            # kernel (or, with initial states, the *fused* scan) under
+            # them would measure something other than what was configured.
+            raise ValueError(
+                "fused_scan/unroll are XLA scan schedule knobs and do not "
+                "apply to backend='pallas' (the kernel has one schedule); "
+                "remat is inherent to the kernel's recomputing backward"
+            )
         if self.backend == "pallas" and initial_states is None:
             return self._pallas(x)
         if self.fused_scan:

@@ -65,8 +65,16 @@ __all__ = ["fused_lstm", "pallas_lstm_available"]
 #: kernel carries ~2.5x the forward's live state (residual reads + dxp +
 #: recompute temporaries), so it takes half the forward's rows.
 def _block_rows(itemsize: int) -> tuple[int, int]:
-    """(fwd_rows, bwd_rows) for a storage dtype of ``itemsize`` bytes."""
-    return (256, 128) if itemsize <= 2 else (128, 64)
+    """(fwd_rows, bwd_rows) for a storage dtype of ``itemsize`` bytes.
+
+    Invariant: ``fwd_rows % bwd_rows == 0``. The backward pass re-tiles
+    the forward-padded residuals (``hseq``/``cseq`` rows padded to
+    ``fwd_rows``) with ``bwd_rows``-sized blocks, which is only correct
+    when the forward block is an exact multiple of the backward block.
+    """
+    fwd_rows, bwd_rows = (256, 128) if itemsize <= 2 else (128, 64)
+    assert fwd_rows % bwd_rows == 0, (fwd_rows, bwd_rows)
+    return fwd_rows, bwd_rows
 
 
 def pallas_lstm_available() -> bool:

@@ -59,10 +59,24 @@ def _shape_bytes(dtype: str, dims: str) -> int:
     return n * size
 
 
+# result shape may be a tuple — '%while.0 = (f32[4,4]{1,0}, f32[2]{0}) while('
+# — whose spaces a bare \S+ cannot span; any multi-array carry (every real
+# scan/fori_loop) prints that way
+_WHILE_RE = re.compile(r"=\s*(\([^)]*\)|\S+)\s+while\(")
+
+
 def collective_stats(hlo_text: str) -> dict:
-    """``{op: {"count": int, "bytes": int}}`` over all collectives found."""
+    """``{op: {"count": int, "bytes": int}}`` over all collectives found.
+
+    ``while_count`` reports HLO ``while`` loops in the program: static
+    counts do not multiply through loop trip counts, so any loop means the
+    tallies may under-report runtime wire volume (see module docstring).
+    """
     stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVES}
+    while_count = 0
     for line in hlo_text.splitlines():
+        if _WHILE_RE.search(line):
+            while_count += 1
         m = _OP_RE.search(line)
         if not m:
             continue
@@ -81,14 +95,27 @@ def collective_stats(hlo_text: str) -> dict:
             for dt, dm in elems:
                 stats[op]["bytes"] += _shape_bytes(dt, dm)
     stats["total_bytes"] = sum(v["bytes"] for v in stats.values() if isinstance(v, dict))
+    stats["while_count"] = while_count
     return stats
 
 
-def step_comm_report(fn: Callable, *args, **kwargs) -> dict:
+def step_comm_report(fn: Callable, *args, allow_loops: bool = False, **kwargs) -> dict:
     """Compile ``fn(*args)`` (jit-wrapped if needed) and report its
-    collective stats. Shardings are taken from the argument placements."""
+    collective stats. Shardings are taken from the argument placements.
+
+    Raises when the compiled program contains ``while`` loops (static
+    per-op counts would silently under-report a loop's repeated
+    collectives) unless ``allow_loops=True`` is passed explicitly.
+    """
     import jax
 
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
     compiled = jitted.lower(*args, **kwargs).compile()
-    return collective_stats(compiled.as_text())
+    stats = collective_stats(compiled.as_text())
+    if stats["while_count"] and not allow_loops:
+        raise ValueError(
+            f"compiled program has {stats['while_count']} while-loop(s); "
+            "static collective counts would under-report them — pass "
+            "allow_loops=True to accept lower-bound numbers"
+        )
+    return stats

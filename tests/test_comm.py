@@ -83,3 +83,52 @@ class TestBandedCommVolume:
         assert banded["total_bytes"] == 2 * bsup.halo * B * F * 4
         # the headline: ~N/(2*halo) = 8x less wire volume
         assert banded["total_bytes"] * 4 < gspmd["total_bytes"]
+
+
+def test_while_loop_detected_and_rejected():
+    """Static counts don't multiply through loops — step_comm_report must
+    refuse a loopy program unless told to accept lower bounds."""
+    import jax
+    from jax import lax
+
+    from stmgcn_tpu.utils.comm import collective_stats, step_comm_report
+
+    def loopy(x):
+        return lax.while_loop(lambda v: v.sum() < 100.0, lambda v: v + 1.0, x)
+
+    compiled = jax.jit(loopy).lower(jnp.ones((4, 4))).compile()
+    stats = collective_stats(compiled.as_text())
+    assert stats["while_count"] >= 1
+
+    with pytest.raises(ValueError, match="while-loop"):
+        step_comm_report(loopy, jnp.ones((4, 4)))
+    assert step_comm_report(loopy, jnp.ones((4, 4)), allow_loops=True)[
+        "while_count"
+    ] >= 1
+
+
+def test_loop_free_program_reports_zero_whiles():
+    from stmgcn_tpu.utils.comm import step_comm_report
+
+    stats = step_comm_report(lambda x: x @ x, jnp.ones((8, 8)))
+    assert stats["while_count"] == 0
+
+
+def test_while_loop_with_tuple_carry_detected():
+    """Real loops (scan/fori with multi-array carries) print tuple result
+    shapes — '%while.0 = (f32[..], f32[..]) while(' — which the detector
+    must count too."""
+    import jax
+    from jax import lax
+
+    from stmgcn_tpu.utils.comm import collective_stats
+
+    def loopy(x, y):
+        def body(c):
+            a, b = c
+            return a + 1.0, b * 0.5
+
+        return lax.while_loop(lambda c: c[0].sum() < 100.0, body, (x, y))
+
+    compiled = jax.jit(loopy).lower(jnp.ones((4, 4)), jnp.ones((2,))).compile()
+    assert collective_stats(compiled.as_text())["while_count"] >= 1

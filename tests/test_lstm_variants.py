@@ -93,3 +93,13 @@ def test_flagship_with_fused_lstm_matches(data):
         np.asarray(base.apply(params, sup, x)),
         rtol=1e-5, atol=1e-6,
     )
+
+
+def test_pallas_backend_rejects_scan_schedule_knobs():
+    """fused_scan/unroll schedule the XLA scan; combining them with the
+    pallas kernel must raise, not silently measure something else."""
+    x = jnp.zeros((2, 4, 8), jnp.float32)
+    for kwargs in ({"fused_scan": True}, {"unroll": 0}, {"unroll": 4}):
+        m = StackedLSTM(hidden_dim=8, num_layers=1, backend="pallas", **kwargs)
+        with pytest.raises(ValueError, match="schedule knobs"):
+            m.init(jax.random.key(0), x)

@@ -281,8 +281,17 @@ def main(argv=None) -> int:
                 fc = Forecaster.from_checkpoint(
                     os.path.join(cfg.train.out_dir, "best.ckpt")
                 )
-                export_forecaster(fc, args.export)
-                print(f"serving artifact written to {args.export}")
+                if getattr(fc, "normalizers", None) is not None:
+                    # heterogeneous multi-city: one fixed-N artifact per
+                    # city (each bakes that city's normalizer)
+                    root, ext = os.path.splitext(args.export)
+                    for c in range(len(fc.normalizers)):
+                        city_path = f"{root}.city{c}{ext}"
+                        export_forecaster(fc, city_path, city=c)
+                        print(f"serving artifact written to {city_path}")
+                else:
+                    export_forecaster(fc, args.export)
+                    print(f"serving artifact written to {args.export}")
             except Exception as e:  # noqa: BLE001 — host 0 must reach the
                 # broadcast below no matter how export dies, or every other
                 # host blocks forever in the collective

@@ -11,6 +11,7 @@ quirk 3), and device placement is explicit and shardable instead of eager
 from stmgcn_tpu.data.loader import ADJ_KEYS, DemandData, load_npz
 from stmgcn_tpu.data.normalize import MinMaxNormalizer, StdNormalizer, normalizer_from_dict
 from stmgcn_tpu.data.pipeline import DemandDataset, Batch
+from stmgcn_tpu.data.hetero import HeteroCityDataset
 from stmgcn_tpu.data.splits import SplitSpec, date_splits
 from stmgcn_tpu.data.synthetic import synthetic_demand, grid_adjacency, synthetic_dataset
 from stmgcn_tpu.data.windowing import WindowSpec, sliding_windows
@@ -20,6 +21,7 @@ __all__ = [
     "Batch",
     "DemandData",
     "DemandDataset",
+    "HeteroCityDataset",
     "MinMaxNormalizer",
     "StdNormalizer",
     "SplitSpec",

@@ -66,6 +66,10 @@ class DemandDataset:
     samples are the concatenation of that mode's slice from every city.
     """
 
+    #: homogeneous cities: one shared shape/normalizer/split (the
+    #: heterogeneous counterpart is data.hetero.HeteroCityDataset)
+    heterogeneous = False
+
     #: normalizer selected per ``normalize=`` kind (None = raw values)
     _NORMALIZERS = {"minmax": MinMaxNormalizer, "std": StdNormalizer, "none": None}
 

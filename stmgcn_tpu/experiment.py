@@ -31,12 +31,39 @@ __all__ = [
 ]
 
 
-def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
-    """Load or synthesize demand data and window/split it per config."""
+def _split_for(d, window: WindowSpec, n_timesteps: int):
+    """One split spec over a series of ``n_timesteps`` per the data config."""
+    n_samples = window.n_samples(n_timesteps)
+    if d.dates is not None:
+        return date_splits(
+            list(d.dates),
+            burn_in=window.burn_in,
+            day_timesteps=d.day_timesteps,
+            val_ratio=d.val_ratio,
+            year=d.year,
+            n_samples=n_samples,
+        )
+    return fraction_splits(n_samples, train=d.train_frac, validate=d.val_frac)
+
+
+def build_dataset(cfg: ExperimentConfig):
+    """Load or synthesize demand data and window/split it per config.
+
+    Returns a :class:`DemandDataset` for same-shape cities, or a
+    :class:`~stmgcn_tpu.data.HeteroCityDataset` when city shapes differ
+    (or ``data.hetero`` forces per-city treatment) — each city then keeps
+    its own normalizer and split calendar.
+    """
     d = cfg.data
     window = WindowSpec(
         d.serial_len, d.daily_len, d.weekly_len, d.day_timesteps, horizon=d.horizon
     )
+    for name, per_city in (("city_rows", d.city_rows), ("city_timesteps", d.city_timesteps)):
+        if per_city is not None and len(per_city) != d.n_cities:
+            raise ValueError(
+                f"data.{name} must list one value per city "
+                f"(n_cities={d.n_cities}), got {per_city}"
+            )
     if d.path is not None:
         paths = [p for p in d.path.split(",") if p]
         if d.n_cities > 1 and len(paths) != d.n_cities:
@@ -48,9 +75,11 @@ def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
     else:
         cities = [
             synthetic_dataset(
-                rows=d.rows,
+                rows=d.city_rows[c] if d.city_rows is not None else d.rows,
                 cols=d.cols,
-                n_timesteps=d.n_timesteps,
+                n_timesteps=(
+                    d.city_timesteps[c] if d.city_timesteps is not None else d.n_timesteps
+                ),
                 m_graphs=cfg.model.m_graphs,
                 day_timesteps=d.day_timesteps,
                 seed=d.seed + c,
@@ -61,20 +90,22 @@ def build_dataset(cfg: ExperimentConfig) -> DemandDataset:
             # optionally collapse to one region-graph structure (distinct
             # demand, common graphs) — lets every support representation
             # (banded/sparse mesh routing) apply across cities
+            if len({c.demand.shape[1] for c in cities}) > 1:
+                raise ValueError(
+                    "shared_graphs needs cities with one region count — "
+                    "a graph stack cannot be shared across differing N"
+                )
             for c in cities[1:]:
                 c.adjs = cities[0].adjs
-    n_samples = window.n_samples(cities[0].demand.shape[0])
-    if d.dates is not None:
-        split = date_splits(
-            list(d.dates),
-            burn_in=window.burn_in,
-            day_timesteps=d.day_timesteps,
-            val_ratio=d.val_ratio,
-            year=d.year,
-            n_samples=n_samples,
-        )
-    else:
-        split = fraction_splits(n_samples, train=d.train_frac, validate=d.val_frac)
+    hetero = len(cities) > 1 and (
+        d.hetero or len({c.demand.shape for c in cities}) > 1
+    )
+    if hetero:
+        from stmgcn_tpu.data import HeteroCityDataset
+
+        splits = [_split_for(d, window, c.demand.shape[0]) for c in cities]
+        return HeteroCityDataset(cities, window, splits, normalize=d.normalize)
+    split = _split_for(d, window, cities[0].demand.shape[0])
     return DemandDataset(
         cities if len(cities) > 1 else cities[0], window, split, normalize=d.normalize
     )
@@ -109,9 +140,12 @@ def _pad_support_nodes(dense, n_pad: int):
     return np.pad(dense, widths)
 
 
-def _dense_supports(cfg: ExperimentConfig, adjs, n_nodes: int):
+def _dense_supports(cfg: ExperimentConfig, adjs):
     """One city's dense support stack, node-padded iff the mesh needs it —
-    the single padding site every support representation derives from."""
+    the single padding site every support representation derives from.
+    ``N`` comes from the adjacencies themselves (heterogeneous cities
+    have per-city region counts)."""
+    n_nodes = next(iter(adjs.values())).shape[0]
     dense = cfg.model.support_config.build_all(adjs.values())
     n_pad = node_pad_target(cfg, n_nodes)
     return _pad_support_nodes(dense, n_pad) if n_pad is not None else dense
@@ -130,7 +164,7 @@ def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
     """
 
     def one(adjs):
-        dense = _dense_supports(cfg, adjs, dataset.n_nodes)
+        dense = _dense_supports(cfg, adjs)
         if not cfg.model.sparse:
             return dense
         from stmgcn_tpu.ops.spmm import stack_from_dense
@@ -190,7 +224,7 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
     if cfg.model.sparse and cfg.mesh.n_devices > 1:
         from stmgcn_tpu.parallel.sparse import sharded_from_dense
 
-        dense = _dense_supports(cfg, dataset.adjs, dataset.n_nodes)
+        dense = _dense_supports(cfg, dataset.adjs)
         routed = tuple(
             sharded_from_dense(dense[m], cfg.mesh.region)
             for m in range(dense.shape[0])
@@ -301,7 +335,14 @@ def build_trainer(
                 "placement (mesh.n_devices > 1 with visible devices)"
             )
         shard_spec = ShardSpec(mesh=placement.mesh)
-    n_pad = node_pad_target(cfg, dataset.n_nodes)
+    hetero = getattr(dataset, "heterogeneous", False)
+    if hetero and cfg.mesh.region > 1:
+        raise ValueError(
+            "region sharding with heterogeneous cities would need per-city "
+            "node padding — shard hetero runs on the dp/branch axes "
+            "(mesh.region=1)"
+        )
+    n_pad = None if hetero else node_pad_target(cfg, dataset.n_nodes)
     model = build_model(
         cfg,
         dataset.n_feats,
@@ -310,11 +351,12 @@ def build_trainer(
         n_real_nodes=dataset.n_nodes if n_pad is not None else None,
     )
     if placement is not None and hasattr(placement, "check_divisibility"):
-        placement.check_divisibility(
-            cfg.train.batch_size,
-            n_pad if n_pad is not None else dataset.n_nodes,
-            m_graphs=cfg.model.m_graphs,
-        )
+        for n_nodes in dataset.city_n_nodes if hetero else [dataset.n_nodes]:
+            placement.check_divisibility(
+                cfg.train.batch_size,
+                n_pad if n_pad is not None else n_nodes,
+                m_graphs=cfg.model.m_graphs,
+            )
     t = cfg.train
     return Trainer(
         model,
@@ -340,7 +382,10 @@ def build_trainer(
             "config": cfg.to_dict(),
             # data-derived model facts a checkpoint consumer needs to rebuild
             # the model without the dataset
-            "derived": {"input_dim": dataset.n_feats, "n_nodes": dataset.n_nodes},
+            "derived": {
+                "input_dim": dataset.n_feats,
+                "n_nodes": dataset.city_n_nodes if hetero else dataset.n_nodes,
+            },
         },
         verbose=verbose,
     )

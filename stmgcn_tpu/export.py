@@ -54,6 +54,7 @@ def _write_blobs(path: str, blobs: list[bytes]) -> None:
 
 
 def _read_blobs(path: str, n: int) -> list[bytes]:
+    file_size = os.stat(path).st_size
     with open(path, "rb") as f:
         if f.read(len(_MAGIC)) != _MAGIC:
             raise ValueError(f"{path} is not an stmgcn-tpu export artifact")
@@ -63,14 +64,23 @@ def _read_blobs(path: str, n: int) -> list[bytes]:
             if len(header) != 8:
                 raise ValueError(f"truncated export artifact: {path}")
             (size,) = struct.unpack("<Q", header)
+            # Bound against the bytes actually present BEFORE allocating:
+            # a corrupt length field must fail cleanly, not attempt a
+            # multi-GB read.
+            if size > file_size - f.tell():
+                raise ValueError(f"truncated export artifact: {path}")
             blob = f.read(size)
             if len(blob) != size:
                 raise ValueError(f"truncated export artifact: {path}")
             blobs.append(blob)
+        if f.tell() != file_size:
+            raise ValueError(
+                f"trailing garbage after final blob in export artifact: {path}"
+            )
     return blobs
 
 
-def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
+def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu"), city=None) -> None:
     """Write ``fc`` (a :class:`~stmgcn_tpu.inference.Forecaster`) to
     ``path`` as a self-contained serving artifact.
 
@@ -83,6 +93,11 @@ def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
     changes nothing about the numbers. Sparse/banded-trained checkpoints
     are restacked to the dense vmapped layout automatically (see the
     module docstring).
+
+    A heterogeneous multi-city forecaster bakes ONE city's shape contract
+    and normalizer per artifact (the artifact's signature is
+    fixed-``N``): pass ``city`` to pick which; export each city to its
+    own file to serve them all.
     """
     import dataclasses
 
@@ -90,6 +105,15 @@ def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
 
     model = fc.model
     params = fc.params
+    hetero = getattr(fc, "normalizers", None) is not None
+    if hetero and city is None:
+        raise ValueError(
+            "heterogeneous multi-city checkpoint: the artifact bakes one "
+            "city's region count and normalizer — pass city= (export each "
+            "city to its own artifact to serve them all)"
+        )
+    if not hetero and city is not None:
+        raise ValueError("city= only applies to heterogeneous multi-city checkpoints")
     m = fc.config.model.m_graphs
     if any(mode != "dense" for mode in model.branch_modes()) or not model.vmap_branches:
         # Sparse/banded-trained (or explicitly looped) models use the
@@ -116,6 +140,12 @@ def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
         model = dataclasses.replace(model, lstm_backend="xla")
 
     n_nodes = fc.derived["n_nodes"]
+    normalizer = fc.normalizer
+    if hetero:
+        if not 0 <= city < len(fc.normalizers):
+            raise ValueError(f"city must be in [0, {len(fc.normalizers)}), got {city}")
+        n_nodes = n_nodes[city]
+        normalizer = fc.normalizers[city]
     input_dim = fc.derived["input_dim"]
     k = model.n_supports
 
@@ -136,8 +166,10 @@ def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu")) -> None:
         "horizon": fc.horizon,
         "m_graphs": m,
         "n_supports": k,
-        "normalizer": fc.normalizer.to_dict() if fc.normalizer is not None else None,
+        "normalizer": normalizer.to_dict() if normalizer is not None else None,
     }
+    if hetero:
+        meta["city"] = city
     _write_blobs(path, [json.dumps(meta).encode("utf-8"), exported.serialize()])
 
 

@@ -32,12 +32,24 @@ __all__ = ["Forecaster"]
 class Forecaster:
     """A trained ST-MGCN ready to forecast from raw demand history."""
 
-    def __init__(self, model, params, normalizer, config: ExperimentConfig, derived: dict):
+    def __init__(
+        self,
+        model,
+        params,
+        normalizer,
+        config: ExperimentConfig,
+        derived: dict,
+        normalizers=None,
+    ):
         self.model = model
         self.params = params
         self.normalizer = normalizer
+        #: heterogeneous multi-city checkpoints: one normalizer per city
+        #: (``derived["n_nodes"]`` is then a per-city list); ``predict``
+        #: selects with ``city=``
+        self.normalizers = normalizers
         self.config = config
-        self.derived = derived  # {"input_dim": C, "n_nodes": N}
+        self.derived = derived  # {"input_dim": C, "n_nodes": N | [N_city...]}
         self._apply = jax.jit(model.apply)
 
     @classmethod
@@ -52,9 +64,15 @@ class Forecaster:
         normalizer = (
             normalizer_from_dict(meta["normalizer"]) if "normalizer" in meta else None
         )
+        normalizers = None
+        if "normalizers" in meta:  # heterogeneous multi-city checkpoint
+            normalizers = [
+                normalizer_from_dict(n) if n is not None else None
+                for n in meta["normalizers"]
+            ]
         model = build_model(cfg, meta["derived"]["input_dim"])
         params = jax.tree.map(jnp.asarray, params)
-        return cls(model, params, normalizer, cfg, meta["derived"])
+        return cls(model, params, normalizer, cfg, meta["derived"], normalizers)
 
     @property
     def seq_len(self) -> int:
@@ -64,19 +82,37 @@ class Forecaster:
     def horizon(self) -> int:
         return self.config.data.horizon
 
-    def predict(self, supports, history, *, normalized: bool = False) -> np.ndarray:
+    def predict(
+        self, supports, history, *, normalized: bool = False, city: int = 0
+    ) -> np.ndarray:
         """Forecast demand from raw-scale history.
 
         ``history``: ``(B, seq_len, N, C)`` windowed observations in raw
         demand units (set ``normalized=True`` if already model-scaled);
         ``supports``: the stacked ``(M, K, N, N)`` array (or sparse pytree)
-        built from the city's graphs. Returns raw-unit forecasts of shape
-        ``(B, N, C)`` or ``(B, H, N, C)``.
+        built from the city's graphs. With a heterogeneous multi-city
+        checkpoint, ``city`` selects that city's normalizer and expected
+        region count. Returns raw-unit forecasts of shape ``(B, N, C)`` or
+        ``(B, H, N, C)``.
         """
-        expected = (self.seq_len, self.derived["n_nodes"], self.derived["input_dim"])
+        n_nodes, normalizer = self.derived["n_nodes"], self.normalizer
+        if self.normalizers is not None:
+            if not 0 <= city < len(self.normalizers):
+                raise ValueError(
+                    f"city must be in [0, {len(self.normalizers)}), got {city}"
+                )
+            normalizer = self.normalizers[city]
+            n_nodes = n_nodes[city]
+        elif city != 0:
+            # mirror export_forecaster: silently applying the shared
+            # normalizer to a city-selecting caller would mask their bug
+            raise ValueError(
+                "city= only applies to heterogeneous multi-city checkpoints"
+            )
+        expected = (self.seq_len, n_nodes, self.derived["input_dim"])
         return serve_predict(
             lambda h: self._apply(self.params, supports, jnp.asarray(h)),
-            self.normalizer,
+            normalizer,
             expected,
             history,
             normalized,

@@ -103,9 +103,28 @@ class Trainer:
     """Drives training of a flax model over a :class:`DemandDataset`."""
 
     #: "auto" data placement goes resident up to this many windowed-array
-    #: bytes (well under any TPU generation's HBM; the model state at this
-    #: scale is tiny next to it)
+    #: bytes when the device doesn't report its memory (the conservative
+    #: fallback; see :meth:`_resident_cap_bytes` for the device-derived cap)
     RESIDENT_CAP_BYTES = 1 << 30
+
+    def _resident_cap_bytes(self) -> int:
+        """Byte budget for "auto" resident data placement.
+
+        Derived from the device's own ``memory_stats()`` when available —
+        half of the currently-free device memory (leaving the other half
+        for params, optimizer state, activations, and XLA scratch) — with
+        :data:`RESIDENT_CAP_BYTES` as the floor/fallback so hosts and
+        backends that report nothing keep the old conservative behavior.
+        """
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+        except Exception:  # backends without memory_stats raise various types
+            return self.RESIDENT_CAP_BYTES
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use", 0)
+        if not limit:
+            return self.RESIDENT_CAP_BYTES
+        return max(self.RESIDENT_CAP_BYTES, (limit - in_use) // 2)
 
     def __init__(
         self,
@@ -145,6 +164,12 @@ class Trainer:
         self.prefetch = prefetch
         if node_pad < 0:
             raise ValueError("node_pad must be >= 0 (padded node rows)")
+        if node_pad and getattr(dataset, "heterogeneous", False):
+            raise ValueError(
+                "node_pad is a single-target-N concept; heterogeneous "
+                "cities have per-city region counts (pad would need to be "
+                "per-city — shard such runs on dp/branch axes instead)"
+            )
         #: extra zero nodes appended so N divides the mesh's region axis;
         #: padded rows are isolated (zero supports), excluded from the gate
         #: pooling (model.n_real_nodes) and masked out of the loss/metrics
@@ -202,7 +227,7 @@ class Trainer:
         self._resident = self.data_placement == "resident" or (
             self.data_placement == "auto"
             and not meshy
-            and dataset.nbytes <= self.RESIDENT_CAP_BYTES
+            and dataset.nbytes <= self._resident_cap_bytes()
         )
 
         for mode in ("train", "validate"):
@@ -325,7 +350,12 @@ class Trainer:
             "seed": self.seed,
             "kept": self._kept,  # top-k retention state survives resume
         }
-        if self.dataset.normalizer is not None:
+        if getattr(self.dataset, "heterogeneous", False):
+            meta["normalizers"] = [
+                n.to_dict() if n is not None else None
+                for n in self.dataset.normalizers
+            ]
+        elif self.dataset.normalizer is not None:
             meta["normalizer"] = self.dataset.normalizer.to_dict()
         meta.update(self.extra_meta)
         return meta
@@ -593,9 +623,10 @@ class Trainer:
             _, params, _ = self._load_state(path)
             params = self.placement.put(params, "state")
         self._log(f"Testing starts at: {time.ctime()}")
+        hetero = getattr(self.dataset, "heterogeneous", False)
         results = {}
         for mode in modes:
-            preds, trues = [], []
+            preds, trues = {}, {}  # per-city accumulation (one key unless hetero)
             # metric accumulation reads batch.y on the host — keep arrays
             for batch, (x, y, mask) in self._placed_batches(mode, with_arrays=True):
                 _, pred = self.step_fns.eval_step(
@@ -604,11 +635,38 @@ class Trainer:
                 pred = np.asarray(pred)[: batch.n_real]
                 if self.node_pad:  # drop padded node rows ((B,[H,]N,C))
                     pred = pred[..., : -self.node_pad, :]
-                preds.append(pred)
-                trues.append(batch.y[: batch.n_real])
-            pred = self.dataset.denormalize(np.concatenate(preds, axis=0))
-            true = self.dataset.denormalize(np.concatenate(trues, axis=0))
-            results[mode] = regression_report(pred, true)
+                preds.setdefault(batch.city, []).append(pred)
+                trues.setdefault(batch.city, []).append(batch.y[: batch.n_real])
+            if hetero:
+                # per-city denormalization (each city has its own scale) +
+                # per-city reports; the overall report pools the flattened
+                # raw-unit values so cities with more regions weigh more,
+                # exactly as their demand points do
+                per_city, flat_p, flat_t = {}, [], []
+                for c in sorted(preds):
+                    p = self.dataset.denormalize(
+                        np.concatenate(preds[c], axis=0), city=c
+                    )
+                    t = self.dataset.denormalize(
+                        np.concatenate(trues[c], axis=0), city=c
+                    )
+                    per_city[f"city{c}"] = regression_report(p, t)
+                    flat_p.append(p.ravel())
+                    flat_t.append(t.ravel())
+                results[mode] = regression_report(
+                    np.concatenate(flat_p), np.concatenate(flat_t)
+                )
+                results[mode]["per_city"] = per_city
+            else:
+                # homogeneous cities share one normalizer and one shape:
+                # pool every city's batches as before
+                pred = self.dataset.denormalize(
+                    np.concatenate([a for c in sorted(preds) for a in preds[c]])
+                )
+                true = self.dataset.denormalize(
+                    np.concatenate([a for c in sorted(trues) for a in trues[c]])
+                )
+                results[mode] = regression_report(pred, true)
             self._log(
                 f"{mode} true MSE: {results[mode]['mse']:.6g}  "
                 f"RMSE: {results[mode]['rmse']:.6g}  "
@@ -616,5 +674,11 @@ class Trainer:
                 f"MAPE: {results[mode]['mape'] * 100:.4g}%  "
                 f"PCC: {results[mode]['pcc']:.4g}"
             )
+            if hetero:
+                for name, rep in results[mode]["per_city"].items():
+                    self._log(
+                        f"  {mode}/{name} RMSE: {rep['rmse']:.6g}  "
+                        f"MAE: {rep['mae']:.6g}  PCC: {rep['pcc']:.4g}"
+                    )
         self._log(f"Testing ends at: {time.ctime()}")
         return results

@@ -164,3 +164,26 @@ def test_export_rejects_bad_file(tmp_path):
     p.write_bytes(b"not an artifact")
     with pytest.raises(ValueError, match="not an stmgcn-tpu export artifact"):
         ExportedForecaster.load(str(p))
+
+
+def test_export_rejects_corrupt_length_field(tmp_path):
+    """A lying 8-byte length must fail cleanly BEFORE any allocation."""
+    import struct
+
+    from stmgcn_tpu.export import _MAGIC
+
+    p = tmp_path / "corrupt.stmgx"
+    # Claims an 8 EiB blob; the file holds 4 bytes.
+    p.write_bytes(_MAGIC + struct.pack("<Q", 1 << 62) + b"abcd")
+    with pytest.raises(ValueError, match="truncated export artifact"):
+        ExportedForecaster.load(str(p))
+
+
+def test_export_rejects_trailing_garbage(setup, tmp_path):
+    fc, supports, ds = setup
+    path = str(tmp_path / "model.stmgx")
+    export_forecaster(fc, path, platforms=("cpu",))
+    with open(path, "ab") as f:
+        f.write(b"\x00garbage appended after the final blob")
+    with pytest.raises(ValueError, match="trailing garbage"):
+        ExportedForecaster.load(path)

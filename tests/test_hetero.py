@@ -1,0 +1,261 @@
+"""Heterogeneous multi-city: per-city shapes, normalizers, splits, metrics.
+
+The reference is single-city (``Data_Container.py:8-29``); BASELINE
+config 4's bar is a real city pair differing in region count, span, and
+demand scale. The key parity property: the pairing machinery must not
+change any single city's math — a city trained alone matches its
+trajectory inside the pair (exactly, for the epoch prefix its batches
+occupy; city order is deterministic and city 0 streams first).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.data import DemandDataset, HeteroCityDataset, WindowSpec, synthetic_dataset
+from stmgcn_tpu.data.splits import fraction_splits
+from stmgcn_tpu.experiment import build_dataset, build_trainer
+
+
+def _pair_cfg(tmp_path, epochs=2):
+    cfg = preset("multicity")
+    cfg.data.city_rows = (4, 3)
+    cfg.data.city_timesteps = (24 * 7 * 2 + 24, 24 * 7 * 2)
+    cfg.mesh.dp = 1
+    cfg.train.epochs = epochs
+    cfg.train.out_dir = str(tmp_path)
+    return cfg
+
+
+def _solo_cfg(tmp_path, epochs=2):
+    cfg = preset("multicity")
+    cfg.data.n_cities = 1
+    cfg.data.city_rows = None
+    cfg.data.city_timesteps = None
+    cfg.data.rows = 4
+    cfg.data.n_timesteps = 24 * 7 * 2 + 24
+    cfg.mesh.dp = 1
+    cfg.train.epochs = epochs
+    cfg.train.out_dir = str(tmp_path)
+    return cfg
+
+
+class TestHeteroDataset:
+    def test_per_city_shapes_normalizers_splits(self, tmp_path):
+        ds = build_dataset(_pair_cfg(tmp_path))
+        assert ds.heterogeneous and not ds.shared_graphs
+        assert ds.city_n_nodes == [16, 9]
+        # per-city normalizers fitted on each city alone
+        n0, n1 = ds.normalizers
+        assert n0.to_dict() != n1.to_dict()
+        assert ds.normalizer is None
+        # per-city splits over each city's own sample count
+        sizes = [c.mode_size("train") for c in ds.cities]
+        assert ds.mode_size("train") == sum(sizes) and sizes[0] != sizes[1]
+        x0, _ = ds.city_arrays("train", 0)
+        x1, _ = ds.city_arrays("train", 1)
+        assert x0.shape[2] == 16 and x1.shape[2] == 9
+
+    def test_batches_never_mix_cities_and_tag_city(self, tmp_path):
+        ds = build_dataset(_pair_cfg(tmp_path))
+        seen = set()
+        for b in ds.batches("train", 16, pad_last=True):
+            seen.add(b.city)
+            expect_n = ds.city_n_nodes[b.city]
+            assert b.x.shape[2] == expect_n and b.x.shape[0] == 16
+        assert seen == {0, 1}
+
+    def test_validations(self, tmp_path):
+        window = WindowSpec(3, 1, 1, 24)
+        a = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 24, seed=0)
+        b = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2, seed=1)
+        ds = HeteroCityDataset([a, b], window)
+        with pytest.raises(ValueError, match="city_arrays"):
+            ds.arrays("train")
+        with pytest.raises(ValueError, match="city="):
+            ds.denormalize(np.zeros(3))
+        with pytest.raises(ValueError, match="city_n_nodes"):
+            ds.n_nodes
+        with pytest.raises(ValueError, match="per city"):
+            HeteroCityDataset([a, b], window, splits=[None])
+        # channel-count mismatch is structural (sizes the LSTM input)
+        bad = dataclasses.replace(b, demand=np.repeat(b.demand, 2, axis=-1))
+        with pytest.raises(ValueError, match="channel count"):
+            HeteroCityDataset([a, bad], window)
+
+    def test_shared_graphs_rejects_differing_region_counts(self, tmp_path):
+        cfg = _pair_cfg(tmp_path)  # city_rows (4, 3): N=16 vs N=9
+        cfg.data.shared_graphs = True
+        with pytest.raises(ValueError, match="region count"):
+            build_dataset(cfg)
+
+    def test_shared_graphs_allows_same_n_different_span(self, tmp_path):
+        """Equal region counts with differing series lengths may share a
+        graph stack (N matches; the hetero pipeline handles per-city T)."""
+        cfg = _pair_cfg(tmp_path)
+        cfg.data.city_rows = (4, 4)
+        cfg.data.city_timesteps = (504, 360)
+        cfg.data.shared_graphs = True
+        ds = build_dataset(cfg)
+        assert ds.heterogeneous and ds.city_n_nodes == [16, 16]
+
+    def test_same_shape_cities_opt_into_hetero(self, tmp_path):
+        cfg = _pair_cfg(tmp_path)
+        cfg.data.city_rows = None
+        cfg.data.city_timesteps = None
+        cfg.data.rows = 3
+        cfg.data.n_timesteps = 24 * 7 * 2 + 24
+        assert not getattr(build_dataset(cfg), "heterogeneous", False)
+        cfg.data.hetero = True  # forces per-city normalizers on twins
+        ds = build_dataset(cfg)
+        assert ds.heterogeneous
+        assert ds.normalizers[0].to_dict() != ds.normalizers[1].to_dict()
+
+
+class TestHeteroParity:
+    def test_single_city_hetero_matches_homogeneous_trajectory(self, tmp_path):
+        """The hetero container with one city IS the single-city pipeline."""
+        data = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 24, seed=0)
+        window = WindowSpec(3, 1, 1, 24)
+        split = fraction_splits(window.n_samples(data.demand.shape[0]))
+        homo = DemandDataset(data, window, split)
+        het = HeteroCityDataset([data], window, [split])
+        assert het.mode_size("train") == homo.mode_size("train")
+        hb = list(het.batches("train", 16, pad_last=True))
+        mb = list(homo.batches("train", 16, pad_last=True))
+        assert len(hb) == len(mb)
+        for h, m in zip(hb, mb):
+            np.testing.assert_array_equal(h.x, m.x)
+            np.testing.assert_array_equal(h.y, m.y)
+            assert h.n_real == m.n_real
+        np.testing.assert_array_equal(
+            het.denormalize(hb[0].y, city=0), homo.denormalize(mb[0].y)
+        )
+
+    def test_city0_trains_identically_alone_and_inside_pair(self, tmp_path):
+        """City 0's training prefix inside the pair == the city alone.
+
+        Cities stream in order, so the first epoch's city-0 batches (and
+        the parameter updates they produce) must be bit-compatible with a
+        single-city run: same data (same synthetic seed), same init (all
+        parameters are region-count-agnostic), same steps.
+        """
+        import jax
+
+        solo = build_trainer(_solo_cfg(tmp_path / "solo"), verbose=False)
+        pair = build_trainer(_pair_cfg(tmp_path / "pair"), verbose=False)
+
+        # identical initial parameters: same seed, N-agnostic shapes
+        jax.tree.map(np.testing.assert_array_equal, solo.params, pair.params)
+
+        def city0_losses(tr, n_steps=3):
+            params, opt = tr.params, tr.opt_state
+            losses = []
+            for batch, (x, y, mask) in tr._placed_batches("train"):
+                if batch.city != 0 or len(losses) >= n_steps:
+                    break
+                params, opt, loss = tr.step_fns.train_step(
+                    params, opt, tr._supports_for(batch), x, y, mask
+                )
+                losses.append(float(loss))
+            return losses, params
+
+        solo_losses, solo_params = city0_losses(solo)
+        pair_losses, pair_params = city0_losses(pair)
+        assert len(solo_losses) == 3
+        np.testing.assert_allclose(solo_losses, pair_losses, rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+            solo_params,
+            pair_params,
+        )
+
+
+class TestHeteroTraining:
+    def test_pair_trains_with_per_city_metrics(self, tmp_path):
+        tr = build_trainer(_pair_cfg(tmp_path), verbose=False)
+        hist = tr.train()
+        assert np.isfinite(hist["train"]).all()
+        res = tr.test(modes=("test",))
+        per_city = res["test"]["per_city"]
+        assert set(per_city) == {"city0", "city1"}
+        for rep in per_city.values():
+            assert np.isfinite(rep["rmse"]) and np.isfinite(rep["pcc"])
+        # checkpoint meta carries one normalizer per city
+        meta = tr._meta()
+        assert len(meta["normalizers"]) == 2
+        assert meta["normalizers"][0] != meta["normalizers"][1]
+        assert meta["derived"]["n_nodes"] == [16, 9]
+
+    def test_hetero_rejects_region_mesh_and_node_pad(self, tmp_path):
+        cfg = _pair_cfg(tmp_path)
+        cfg.mesh.dp, cfg.mesh.region = 1, 2
+        with pytest.raises(ValueError, match="region"):
+            build_trainer(cfg, verbose=False)
+
+        from stmgcn_tpu.train import Trainer
+
+        ds = build_dataset(_pair_cfg(tmp_path))
+        with pytest.raises(ValueError, match="node_pad"):
+            Trainer(None, ds, None, node_pad=2, out_dir=str(tmp_path))
+
+
+class TestHeteroServing:
+    def test_forecaster_serves_each_city_from_hetero_checkpoint(self, tmp_path):
+        """A hetero-trained checkpoint serves both cities: per-city
+        normalizer + region count selected with predict(city=...)."""
+        from stmgcn_tpu.inference import Forecaster
+        from stmgcn_tpu.experiment import build_supports
+
+        cfg = _pair_cfg(tmp_path, epochs=1)
+        tr = build_trainer(cfg, verbose=False)
+        tr.train()
+        fc = Forecaster.from_checkpoint(tr.best_path)
+        assert fc.normalizers is not None and len(fc.normalizers) == 2
+
+        ds = build_dataset(cfg)
+        sup = build_supports(cfg, ds)
+        for city, n in enumerate(ds.city_n_nodes):
+            hist = np.random.default_rng(city).uniform(
+                0, 40, (2, fc.seq_len, n, ds.n_feats)
+            ).astype(np.float32)
+            out = fc.predict(np.asarray(sup.for_city(city)), hist, city=city)
+            assert out.shape == (2, n, ds.n_feats) and np.isfinite(out).all()
+        # wrong city => shape validation catches the mismatch
+        with pytest.raises(ValueError):
+            fc.predict(
+                np.asarray(sup.for_city(0)),
+                np.zeros((2, fc.seq_len, ds.city_n_nodes[0], ds.n_feats), np.float32),
+                city=1,
+            )
+
+    def test_hetero_export_per_city(self, tmp_path):
+        """export_forecaster bakes one city per artifact; city= required."""
+        from stmgcn_tpu.experiment import build_supports
+        from stmgcn_tpu.export import ExportedForecaster, export_forecaster
+        from stmgcn_tpu.inference import Forecaster
+
+        cfg = _pair_cfg(tmp_path, epochs=1)
+        tr = build_trainer(cfg, verbose=False)
+        tr.train()
+        fc = Forecaster.from_checkpoint(tr.best_path)
+        with pytest.raises(ValueError, match="pass city="):
+            export_forecaster(fc, str(tmp_path / "x.stmgx"), platforms=("cpu",))
+
+        ds = build_dataset(cfg)
+        sup = build_supports(cfg, ds)
+        for c, n in enumerate(ds.city_n_nodes):
+            path = str(tmp_path / f"model.city{c}.stmgx")
+            export_forecaster(fc, path, platforms=("cpu",), city=c)
+            loaded = ExportedForecaster.load(path)
+            hist = np.random.default_rng(c).uniform(
+                0, 40, (2, fc.seq_len, n, ds.n_feats)
+            ).astype(np.float32)
+            np.testing.assert_allclose(
+                loaded.predict(np.asarray(sup.for_city(c)), hist),
+                fc.predict(np.asarray(sup.for_city(c)), hist, city=c),
+                rtol=1e-5,
+                atol=1e-4,
+            )

@@ -203,9 +203,11 @@ class TestHaloExchange:
 
 class TestEndToEndShardedTrainer:
     def test_multicity_preset_trains_on_mesh(self, eight_devices, tmp_path):
+        """Heterogeneous pair on the dp=8 mesh: batch axis shards, node
+        axes stay whole, per-city shapes each get their own compiled step."""
         cfg = preset("multicity")
-        cfg.data.rows = 4  # N=16, divisible by region=1; dp=8 divides batch 64
-        cfg.data.n_timesteps = 24 * 7 * 2 + 24
+        cfg.data.city_rows = (4, 3)  # dp=8 divides batch 64; region=1
+        cfg.data.city_timesteps = (24 * 7 * 2 + 24, 24 * 7 * 2)
         cfg.train.epochs = 1
         cfg.train.out_dir = str(tmp_path)
         trainer = build_trainer(cfg, verbose=False)

@@ -290,10 +290,25 @@ class TestConfigAndExperiment:
             preset("nope")
 
     def test_build_dataset_multicity(self):
+        """The multicity preset is heterogeneous: per-city N/T/graphs."""
         cfg = preset("multicity")
+        ds = build_dataset(cfg)
+        assert ds.n_cities == 2 and ds.heterogeneous
+        assert ds.city_n_nodes == [144, 100]
+        assert ds.mode_size("train") == sum(
+            c.mode_size("train") for c in ds.cities
+        )
+        x0, _ = ds.city_arrays("train", 0)
+        assert x0.shape[2] == 144
+
+    def test_build_dataset_multicity_homogeneous(self):
+        """Same-shape cities still pool into one homogeneous dataset."""
+        cfg = preset("multicity")
+        cfg.data.city_rows = None
+        cfg.data.city_timesteps = None
         cfg.data.n_timesteps = 24 * 7 * 2 + 48
         ds = build_dataset(cfg)
-        assert ds.n_cities == 2
+        assert ds.n_cities == 2 and not ds.heterogeneous
         assert ds.mode_size("train") == ds.split.mode_len["train"] * 2
         x, y = ds.arrays("train")
         assert x.shape[0] == ds.mode_size("train")
@@ -306,8 +321,8 @@ class TestConfigAndExperiment:
         from stmgcn_tpu.train import CitySupports
 
         cfg = preset("multicity")
-        cfg.data.rows = 4
-        cfg.data.n_timesteps = 24 * 7 * 2 + 24
+        cfg.data.city_rows = (4, 3)
+        cfg.data.city_timesteps = (24 * 7 * 2 + 24, 24 * 7 * 2)
         cfg.mesh.dp = 1  # single device keeps this test light; the dp-mesh
         cfg.train.epochs = 2  # variant runs in tests/test_parallel.py
         cfg.train.out_dir = str(tmp_path)
@@ -339,6 +354,8 @@ class TestConfigAndExperiment:
 
     def test_multicity_shared_graphs_knob(self):
         cfg = preset("multicity")
+        cfg.data.city_rows = None  # shared graphs need same-shape cities
+        cfg.data.city_timesteps = None
         cfg.data.n_timesteps = 24 * 7 * 2 + 48
         cfg.data.shared_graphs = True
         assert build_dataset(cfg).shared_graphs
@@ -347,8 +364,8 @@ class TestConfigAndExperiment:
         from stmgcn_tpu.experiment import route_supports
 
         cfg = preset("multicity")
-        cfg.data.rows = 4
-        cfg.data.n_timesteps = 24 * 7 * 2 + 24
+        cfg.data.city_rows = (4, 3)
+        cfg.data.city_timesteps = (24 * 7 * 2 + 24, 24 * 7 * 2)
         cfg.model.sparse = True
         ds = build_dataset(cfg)
         with pytest.raises(ValueError, match="per-city"):

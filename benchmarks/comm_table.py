@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Per-step collective wire volume for every sharding plan, from compiled HLO.
+
+Builds the full training step (forward + grad + Adam) for each of the
+framework's distributed execution plans at the scaled operating point and
+tallies the collectives XLA actually emitted
+(``stmgcn_tpu.utils.comm.step_comm_report`` — measured program text, not
+an analytic model). Runs entirely on the 8-virtual-device CPU mesh: HLO
+collective structure is a function of the sharding annotations, not of
+which backend executes them, so the table holds for a TPU mesh of the
+same shape (byte counts; achieved bandwidth obviously differs).
+
+Plans (the communication layer the reference lacks outright — SURVEY.md
+§2 "no NCCL/distributed anywhere"):
+
+- ``dp8``            batch sharded 8 ways; gradient all-reduce
+- ``region8-gspmd``  node axis sharded; XLA's automatic conv plan
+- ``region8-auto``   banded branches on the explicit halo plan
+                     (collective-permute), the rest GSPMD
+- ``region8-sparse`` block-CSR row strips per shard
+- ``branch3``        graph branches sharded; sum fusion becomes one psum
+
+Usage: python benchmarks/comm_table.py [rows] [batch]
+Emits one JSON line per plan plus a markdown table on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_plan(name: str, rows: int, batch: int):
+    from stmgcn_tpu.config import preset
+
+    cfg = preset("scaled")
+    cfg.data.rows = rows
+    cfg.data.n_timesteps = 24 * 7 * 2 + 2 * batch
+    cfg.train.batch_size = batch
+    cfg.train.out_dir = f"/tmp/comm_table_{name}"
+    cfg.train.epochs = 1
+    # keep the measurement about sharding, not scan scheduling
+    cfg.model.dtype = "bfloat16"
+    if name == "dp8":
+        cfg.mesh.dp, cfg.mesh.region = 8, 1
+        cfg.mesh.region_strategy = "gspmd"
+    elif name == "region8-gspmd":
+        cfg.mesh.region, cfg.mesh.region_strategy = 8, "gspmd"
+    elif name == "region8-auto":
+        cfg.mesh.region, cfg.mesh.region_strategy = 8, "auto"
+    elif name == "region8-sparse":
+        cfg.mesh.region, cfg.mesh.region_strategy = 8, "gspmd"
+        cfg.model.sparse = True
+    elif name == "branch3":
+        cfg.mesh.dp, cfg.mesh.region, cfg.mesh.branch = 1, 1, 3
+        cfg.mesh.region_strategy = "gspmd"
+    else:
+        raise ValueError(name)
+    return cfg
+
+
+def measure(name: str, rows: int, batch: int) -> dict:
+    from stmgcn_tpu.experiment import build_trainer
+    from stmgcn_tpu.utils.comm import step_comm_report
+
+    cfg = build_plan(name, rows, batch)
+    tr = build_trainer(cfg, verbose=False)
+    batch_obj, (x, y, mask) = next(tr._placed_batches("train", with_arrays=True))
+    stats = step_comm_report(
+        tr.step_fns.train_step,
+        tr.params,
+        tr.opt_state,
+        tr._supports_for(batch_obj),
+        x,
+        y,
+        mask,
+    )
+    return {
+        "plan": name,
+        "rows": rows,
+        "batch": batch,
+        "n_nodes": x.shape[2],
+        **{
+            op: stats[op]
+            for op in (
+                "all-gather",
+                "all-reduce",
+                "collective-permute",
+                "reduce-scatter",
+                "all-to-all",
+            )
+        },
+        "total_bytes": stats["total_bytes"],
+        "while_count": stats["while_count"],
+    }
+
+
+PLANS = ("dp8", "region8-gspmd", "region8-auto", "region8-sparse", "branch3")
+
+
+def main() -> None:
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+
+    from stmgcn_tpu.utils import force_host_platform
+
+    force_host_platform("cpu", n_devices=8)
+
+    results = []
+    for name in PLANS:
+        try:
+            r = measure(name, rows, batch)
+        except Exception as e:  # report per-plan, keep the rest of the table
+            r = {"plan": name, "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+
+    print("\n| plan | all-gather | all-reduce | permute | reduce-scatter | total/step |")
+    print("|---|---|---|---|---|---|")
+    for r in results:
+        if "error" in r:
+            print(f"| {r['plan']} | error: {r['error'][:60]} | | | | |")
+            continue
+
+        def mb(op):
+            return f"{r[op]['bytes'] / 1e6:.2f} MB x{r[op]['count']}"
+
+        print(
+            f"| {r['plan']} | {mb('all-gather')} | {mb('all-reduce')} | "
+            f"{mb('collective-permute')} | {mb('reduce-scatter')} | "
+            f"{r['total_bytes'] / 1e6:.2f} MB |"
+        )
+
+
+if __name__ == "__main__":
+    main()

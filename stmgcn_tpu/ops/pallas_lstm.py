@@ -55,24 +55,42 @@ from jax.experimental import pallas as pl
 
 __all__ = ["fused_lstm", "pallas_lstm_available"]
 
-#: rows per grid step, by storage itemsize — sized so each kernel's
-#: blocks plus double-buffering and straight-line temporaries stay inside
-#: the ~16 MB/core scoped VMEM limit. Bigger blocks amortize MXU pipeline
-#: fill across the T*L unrolled small matmuls (measured on v5e, bf16:
-#: 256-row fwd blocks are 1.35x faster end-to-end than 128); fp32 blocks
-#: are half-size because the same byte budget holds half the rows
-#: (256-row fp32 fwd blocks overflow scoped VMEM by ~11 MB). The backward
-#: kernel carries ~2.5x the forward's live state (residual reads + dxp +
-#: recompute temporaries), so it takes half the forward's rows.
-def _block_rows(itemsize: int) -> tuple[int, int]:
-    """(fwd_rows, bwd_rows) for a storage dtype of ``itemsize`` bytes.
+#: rows per grid step — sized so each kernel's blocks plus
+#: double-buffering and straight-line temporaries stay inside the
+#: ~16 MB/core scoped VMEM limit. Bigger blocks amortize MXU pipeline
+#: fill across the T*L unrolled small matmuls (measured on v5e, bf16 at
+#: T=12/L=3: 256-row fwd blocks are 1.35x faster end-to-end than 128);
+#: fp32 blocks are half-size because the same byte budget holds half the
+#: rows (256-row fp32 fwd blocks overflow scoped VMEM by ~11 MB). The
+#: backward kernel carries ~2.5x the forward's live state (residual
+#: reads + dxp + recompute temporaries), so it takes half the forward's
+#: rows.
+def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
+    """(fwd_rows, bwd_rows) for a storage dtype of ``itemsize`` bytes and
+    a ``T x L`` recurrence.
+
+    Every VMEM-resident term scales as ``rows * T * (5 + 2L) * H``
+    (``xp``+``out`` blocks plus the two ``(T, L, rows, H)`` residual
+    blocks), so the row count derives from the measured-good calibration
+    point (T=12, L=3: 256 bf16 / 128 fp32; 512 bf16 and 256 fp32
+    overflow — v5e) by inverse scaling. Longer sequences (the T=24
+    longhorizon preset) automatically take proportionally narrower
+    blocks instead of overflowing scoped VMEM. Rows round down to a
+    power of two and floor at the dtype's sublane tile (16 bf16 /
+    8 fp32).
 
     Invariant: ``fwd_rows % bwd_rows == 0``. The backward pass re-tiles
     the forward-padded residuals (``hseq``/``cseq`` rows padded to
     ``fwd_rows``) with ``bwd_rows``-sized blocks, which is only correct
     when the forward block is an exact multiple of the backward block.
     """
-    fwd_rows, bwd_rows = (256, 128) if itemsize <= 2 else (128, 64)
+    base_fwd = 256 if itemsize <= 2 else 128
+    min_rows = 16 if itemsize <= 2 else 8
+    scale = (12 * (5 + 2 * 3)) / (T * (5 + 2 * L))
+    fwd_rows = base_fwd
+    while fwd_rows > min_rows and fwd_rows > base_fwd * scale:
+        fwd_rows //= 2
+    bwd_rows = max(min_rows, fwd_rows // 2)
     assert fwd_rows % bwd_rows == 0, (fwd_rows, bwd_rows)
     return fwd_rows, bwd_rows
 
@@ -246,7 +264,7 @@ def _run_fwd(x_proj0, wh_stack, wx_stack, b_stack):
     R, T, four_h = x_proj0.shape
     L, h_dim, _ = wh_stack.shape
     dtype = x_proj0.dtype
-    block_fwd, _ = _block_rows(jnp.dtype(dtype).itemsize)
+    block_fwd, _ = _block_rows(jnp.dtype(dtype).itemsize, T, L)
     xp, _ = _pad_rows_axis1(x_proj0.swapaxes(0, 1), block_fwd)  # (T, Rp, 4H)
     rp = xp.shape[1]
     grid = (rp // block_fwd,)
@@ -291,7 +309,7 @@ def _fused_bwd(residuals, cotangents):
     L, h_dim, _ = wh_stack.shape
     dtype = x_proj0.dtype
 
-    _, block_bwd = _block_rows(jnp.dtype(dtype).itemsize)
+    _, block_bwd = _block_rows(jnp.dtype(dtype).itemsize, T, L)
     xp, _ = _pad_rows_axis1(x_proj0.swapaxes(0, 1), block_bwd)  # (T, Rp, 4H)
     rp = xp.shape[1]
     gout, _ = _pad_rows_axis1(g_out.astype(dtype).swapaxes(0, 1), block_bwd)

@@ -180,3 +180,57 @@ def test_pallas_bf16_gradients(data):
         denom = np.linalg.norm(a) * np.linalg.norm(b)
         if denom > 1e-12:
             assert (a * b).sum() / denom > 0.99, path
+
+
+class TestBlockSizing:
+    """VMEM-derived block rows scale inversely with the T*L recurrence."""
+
+    def test_calibration_point_unchanged(self):
+        from stmgcn_tpu.ops.pallas_lstm import _block_rows
+
+        assert _block_rows(2, 12, 3) == (256, 128)  # measured-good on v5e
+        assert _block_rows(4, 12, 3) == (128, 64)
+
+    def test_longhorizon_halves_blocks(self):
+        from stmgcn_tpu.ops.pallas_lstm import _block_rows
+
+        # T=24 doubles every VMEM-resident term: rows halve, no overflow
+        assert _block_rows(2, 24, 3) == (128, 64)
+        assert _block_rows(4, 24, 3) == (64, 32)
+
+    def test_floors_at_sublane_tile(self):
+        from stmgcn_tpu.ops.pallas_lstm import _block_rows
+
+        fwd16, bwd16 = _block_rows(2, 500, 8)
+        fwd8, bwd8 = _block_rows(4, 500, 8)
+        assert fwd16 >= 16 and bwd16 >= 16 and fwd16 % bwd16 == 0
+        assert fwd8 >= 8 and bwd8 >= 8 and fwd8 % bwd8 == 0
+
+
+def test_pallas_matches_scan_at_longhorizon_t24():
+    """T=24, L=3 (the longhorizon preset's recurrence shape): the
+    auto-narrowed blocks keep kernel math identical to the scan path."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(8, 24, 3)).astype(np.float32))
+    base = StackedLSTM(hidden_dim=8, num_layers=3)
+    pallas = StackedLSTM(hidden_dim=8, num_layers=3, backend="pallas")
+    params = base.init(jax.random.key(0), x)
+    want_out, want_fin = base.apply(params, x)
+    got_out, got_fin = pallas.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got_out), np.asarray(want_out), rtol=1e-5, atol=1e-6
+    )
+
+    def loss(model, p):
+        out, _ = model.apply(p, x)
+        return jnp.mean(out ** 2)
+
+    g_base = jax.grad(lambda p: loss(base, p))(params)
+    g_pallas = jax.grad(lambda p: loss(pallas, p))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6
+        ),
+        g_pallas,
+        g_base,
+    )

@@ -69,6 +69,30 @@ class DataConfig:
     val_frac: float = 0.1
     seed: int = 0
 
+    def override(self, **fields) -> "DataConfig":
+        """Set fields keeping per-city companions consistent.
+
+        Presets carry coupled fields (``n_cities`` with ``city_rows`` /
+        ``city_timesteps``); overriding one in isolation leaves the config
+        self-contradictory and fails validation only later, in
+        ``build_dataset``. Overriding through this helper drops any
+        per-city tuple whose length no longer matches ``n_cities`` (unless
+        the same call replaces it). Returns ``self`` for chaining.
+        """
+        for k in fields:
+            if not hasattr(self, k):
+                raise AttributeError(f"DataConfig has no field {k!r}")
+        for k, v in fields.items():
+            setattr(self, k, v)
+        if "n_cities" in fields:
+            for name in ("city_rows", "city_timesteps"):
+                if name in fields:
+                    continue
+                per_city = getattr(self, name)
+                if per_city is not None and len(per_city) != self.n_cities:
+                    setattr(self, name, None)
+        return self
+
     @property
     def day_timesteps(self) -> int:
         return 24 // self.dt

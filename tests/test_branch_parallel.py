@@ -85,9 +85,7 @@ class TestBranchParallelParity:
 
     def test_trainer_end_to_end_on_branch_mesh(self, eight_devices, tmp_path):
         cfg = preset("multicity")
-        cfg.data.rows = 4
-        cfg.data.n_cities = 1
-        cfg.data.n_timesteps = 24 * 7 * 2 + 24
+        cfg.data.override(rows=4, n_cities=1, n_timesteps=24 * 7 * 2 + 24)
         cfg.model.m_graphs = 3
         cfg.train.epochs = 1
         cfg.train.batch_size = 16

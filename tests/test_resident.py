@@ -94,7 +94,6 @@ def test_index_only_batches_skip_host_copies():
 
 def test_resident_rejected_on_mesh():
     cfg = preset("multicity")
-    cfg.mesh.n_virtual_devices = 8
     cfg.train.data_placement = "resident"
     with pytest.raises(ValueError, match="resident"):
         build_trainer(cfg, verbose=False)
@@ -102,7 +101,6 @@ def test_resident_rejected_on_mesh():
 
 def test_mesh_auto_streams():
     cfg = preset("multicity")
-    cfg.mesh.n_virtual_devices = 8
     cfg.train.data_placement = "auto"
     trainer = build_trainer(cfg, verbose=False)
     assert trainer._resident is False

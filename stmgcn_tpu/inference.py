@@ -16,6 +16,8 @@ state.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -83,7 +85,12 @@ class Forecaster:
         return self.config.data.horizon
 
     def predict(
-        self, supports, history, *, normalized: bool = False, city: int = 0
+        self,
+        supports,
+        history,
+        *,
+        normalized: bool = False,
+        city: Optional[int] = None,
     ) -> np.ndarray:
         """Forecast demand from raw-scale history.
 
@@ -91,19 +98,35 @@ class Forecaster:
         demand units (set ``normalized=True`` if already model-scaled);
         ``supports``: the stacked ``(M, K, N, N)`` array (or sparse pytree)
         built from the city's graphs. With a heterogeneous multi-city
-        checkpoint, ``city`` selects that city's normalizer and expected
-        region count. Returns raw-unit forecasts of shape ``(B, N, C)`` or
-        ``(B, H, N, C)``.
+        checkpoint, ``city`` is REQUIRED and selects that city's normalizer
+        and expected region count — cities may share shapes (hetero twins),
+        so no shape check could catch a wrong default. Returns raw-unit
+        forecasts of shape ``(B, N, C)`` or ``(B, H, N, C)``.
         """
         n_nodes, normalizer = self.derived["n_nodes"], self.normalizer
         if self.normalizers is not None:
+            if city is None:
+                if len(self.normalizers) > 1:
+                    # hetero cities can share N (twins with distinct
+                    # normalizers), so an implicit city 0 would silently
+                    # denormalize another city's data with nothing
+                    # downstream to catch it. Unlike export_forecaster
+                    # (which always demands city= because the artifact
+                    # bakes one city in), a single-normalizer checkpoint
+                    # has nothing to choose — default to it.
+                    raise ValueError(
+                        "this checkpoint holds "
+                        f"{len(self.normalizers)} per-city normalizers; "
+                        "pass city= to select one"
+                    )
+                city = 0
             if not 0 <= city < len(self.normalizers):
                 raise ValueError(
                     f"city must be in [0, {len(self.normalizers)}), got {city}"
                 )
             normalizer = self.normalizers[city]
             n_nodes = n_nodes[city]
-        elif city != 0:
+        elif city not in (None, 0):
             # mirror export_forecaster: silently applying the shared
             # normalizer to a city-selecting caller would mask their bug
             raise ValueError(

@@ -223,6 +223,14 @@ class TestHeteroServing:
             ).astype(np.float32)
             out = fc.predict(np.asarray(sup.for_city(city)), hist, city=city)
             assert out.shape == (2, n, ds.n_feats) and np.isfinite(out).all()
+        # omitting city= on a multi-normalizer checkpoint is an error, not
+        # a silent city-0 default (hetero twins can share N, so no shape
+        # check could catch the wrong normalizer)
+        with pytest.raises(ValueError, match="pass city="):
+            fc.predict(
+                np.asarray(sup.for_city(0)),
+                np.zeros((2, fc.seq_len, ds.city_n_nodes[0], ds.n_feats), np.float32),
+            )
         # wrong city => shape validation catches the mismatch
         with pytest.raises(ValueError):
             fc.predict(

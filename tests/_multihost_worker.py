@@ -1,0 +1,131 @@
+"""Worker for the 2-process multi-host tests (run as a subprocess).
+
+Not a pytest module (underscore prefix): ``tests/test_multihost.py``
+launches two copies of this script, each joining a real
+``jax.distributed`` job over local gloo collectives, to execute the
+code paths that only exist when ``jax.process_count() > 1``:
+
+- ``Trainer._load_state``'s lead-read + broadcast restore (and its
+  error-in-payload path, where a lead-side failure must raise on every
+  process instead of leaving peers blocked in the collective),
+- the CLI export-status broadcast (every host exits nonzero when the
+  lead's export fails).
+
+Each process gets its own ``out_dir`` and only process 0's contains a
+checkpoint — a non-lead process can therefore produce the checkpoint's
+parameter digest only by actually receiving the broadcast.
+
+Usage: python _multihost_worker.py <scenario> <proc_id> <port> <out_dir>
+       [export_path]
+Scenarios: restore | cli_export
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+
+def params_digest(params) -> str:
+    """Order-stable sha256 over every array leaf in the params pytree."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for path, leaf in sorted(
+        jax.tree_util.tree_flatten_with_path(params)[0], key=lambda kv: str(kv[0])
+    ):
+        h.update(str(path).encode())
+        h.update(np.ascontiguousarray(np.asarray(leaf, np.float32)).tobytes())
+    return h.hexdigest()
+
+
+def worker_config(out_dir: str):
+    """The tiny training config shared by the parent test and both workers
+    (shapes must match for the broadcast state to be restorable)."""
+    from stmgcn_tpu.config import preset
+
+    cfg = preset("smoke")
+    cfg.data.rows = 4
+    cfg.data.n_timesteps = 24 * 7 * 2 + 24
+    cfg.train.epochs = 2
+    cfg.train.out_dir = out_dir
+    return cfg
+
+
+def _init(proc_id: int, port: str) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from stmgcn_tpu.utils import force_host_platform
+
+    force_host_platform("cpu")
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=2, process_id=proc_id
+    )
+
+
+def scenario_restore(proc_id: int, out_dir: str) -> None:
+    import jax
+
+    from stmgcn_tpu.experiment import build_trainer
+
+    trainer = build_trainer(worker_config(out_dir), verbose=False)
+    assert jax.process_count() == 2, "distributed init did not take"
+    meta = trainer.restore(os.path.join(out_dir, "best.ckpt"))
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "proc": proc_id,
+                "epoch": meta["epoch"],
+                "best_val": meta["best_val"],
+                "digest": params_digest(trainer.params),
+            }
+        ),
+        flush=True,
+    )
+
+    # Error-in-payload: the lead fails to read (no such file) and every
+    # process must raise together — a hang here means the lead bailed
+    # before the collective and left the peer blocked in it.
+    try:
+        trainer.restore(os.path.join(out_dir, "missing.ckpt"))
+        print("ERRORPATH missing-raise", flush=True)
+    except RuntimeError as e:
+        ok = "lead process failed to load" in str(e)
+        print(f"ERRORPATH {'ok' if ok else f'wrong-message: {e}'}", flush=True)
+
+
+def scenario_cli_export(proc_id: int, out_dir: str, export_path: str) -> None:
+    from stmgcn_tpu.cli import main
+
+    cfg = worker_config(out_dir)
+    rc = main(
+        [
+            "--preset", "smoke",
+            "--rows", str(cfg.data.rows),
+            "--timesteps", str(cfg.data.n_timesteps),
+            "--epochs", str(cfg.train.epochs),
+            "--out-dir", out_dir,
+            "--test-only",
+            "--export", export_path,
+        ]
+    )
+    print(f"CLIRC {rc}", flush=True)
+
+
+def main_() -> None:
+    scenario, proc_id, port, out_dir = sys.argv[1:5]
+    _init(int(proc_id), port)
+    if scenario == "restore":
+        scenario_restore(int(proc_id), out_dir)
+    elif scenario == "cli_export":
+        scenario_cli_export(int(proc_id), out_dir, sys.argv[5])
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+
+if __name__ == "__main__":
+    main_()

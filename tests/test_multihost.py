@@ -1,0 +1,136 @@
+"""Multi-host coordination paths executed with two REAL processes.
+
+Round-3 review finding: the lead-read + broadcast restore
+(``train/trainer.py`` ``_load_state``) and the CLI export-status
+broadcast only ever ran their ``process_count == 1`` branches in tests.
+These tests launch two subprocesses joined into one ``jax.distributed``
+job over local gloo collectives (CPU), so the collective code itself
+executes — including the error-in-payload design where a lead-side
+failure must raise on *every* process rather than leaving peers blocked
+in the collective.
+
+Only process 0's ``out_dir`` holds a checkpoint: process 1 can produce
+the checkpoint's parameter digest only by receiving the broadcast, so
+these assertions genuinely fail if the broadcast logic breaks (verified
+by deliberately skipping the non-lead broadcast — both tests then hang
+into the timeout/fail).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _multihost_worker import params_digest, worker_config
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_multihost_worker.py")
+
+pytestmark = pytest.mark.slow
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_pair(scenario: str, dirs, extra=(), timeout=420):
+    """Launch both workers, wait, and return their outputs."""
+    port = _free_port()
+    env = dict(os.environ)
+    # the pytest process's own platform forcing must not leak its
+    # XLA_FLAGS (8 virtual devices) into the workers
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, scenario, str(i), str(port), dirs[i], *extra],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+@pytest.fixture(scope="module")
+def trained_lead_dir(tmp_path_factory):
+    """A checkpoint in process 0's out_dir only (trained in-process)."""
+    from stmgcn_tpu.experiment import build_trainer
+    from stmgcn_tpu.train.checkpoint import load_checkpoint
+
+    lead = str(tmp_path_factory.mktemp("mh_lead"))
+    trainer = build_trainer(worker_config(lead), verbose=False)
+    trainer.train()
+    meta, params, _ = load_checkpoint(
+        os.path.join(lead, "best.ckpt"), trainer.params, trainer.opt_state
+    )
+    return lead, meta, params_digest(params)
+
+
+def test_restore_broadcasts_state_and_error(trained_lead_dir, tmp_path):
+    lead, meta, expect_digest = trained_lead_dir
+    follower = str(tmp_path / "follower")
+    os.makedirs(follower)
+    outs = _run_pair("restore", (lead, follower))
+
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+    results = {}
+    for rc, out, err in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert line, f"no RESULT line in {out!r}"
+        r = json.loads(line[0][len("RESULT "):])
+        results[r["proc"]] = r
+        # the lead-side read failure must raise identically on this process
+        assert "ERRORPATH ok" in out, out
+
+    assert set(results) == {0, 1}
+    for r in results.values():
+        assert r["epoch"] == meta["epoch"]
+        assert r["best_val"] == pytest.approx(meta["best_val"])
+        # process 1 has no checkpoint file: matching the trained digest
+        # (distinct from the fresh-init digest) proves the broadcast
+        assert r["digest"] == expect_digest
+
+
+def test_cli_export_failure_fails_every_host(trained_lead_dir, tmp_path):
+    lead, _, _ = trained_lead_dir
+    follower = str(tmp_path / "follower")
+    os.makedirs(follower)
+    # the export target's parent directory does not exist -> the lead's
+    # export fails; the status broadcast must turn that into rc=1 on BOTH
+    bad = str(tmp_path / "no_such_dir" / "m.stmgx")
+    outs = _run_pair("cli_export", (lead, follower), extra=(bad,))
+    for i, (rc, out, err) in enumerate(outs):
+        assert "CLIRC 1" in out, (
+            f"proc {i} should exit 1 on lead export failure\n"
+            f"stdout:{out}\nstderr:{err[-2000:]}"
+        )
+
+
+def test_cli_export_success_on_lead_only(trained_lead_dir, tmp_path):
+    lead, _, _ = trained_lead_dir
+    follower = str(tmp_path / "follower")
+    os.makedirs(follower)
+    target = str(tmp_path / "model.stmgx")
+    outs = _run_pair("cli_export", (lead, follower), extra=(target,))
+    for i, (rc, out, err) in enumerate(outs):
+        assert "CLIRC 0" in out, (
+            f"proc {i} rc\nstdout:{out}\nstderr:{err[-2000:]}"
+        )
+    assert os.path.exists(target)

@@ -336,33 +336,42 @@ def build_trainer(
             )
         shard_spec = ShardSpec(mesh=placement.mesh)
     hetero = getattr(dataset, "heterogeneous", False)
-    if hetero and cfg.mesh.region > 1:
-        raise ValueError(
-            "region sharding with heterogeneous cities would need per-city "
-            "node padding — shard hetero runs on the dp/branch axes "
-            "(mesh.region=1)"
+    if hetero:
+        # per-city padding: each city's N rounds up to the region extent
+        # independently (jit compiles one step per city shape anyway);
+        # cities whose padded shape differs from true N get their own
+        # gate-pooling divisor via Trainer's city_n_real
+        targets = [node_pad_target(cfg, n) for n in dataset.city_n_nodes]
+        city_pads = tuple(
+            (t - n) if t is not None else 0
+            for t, n in zip(targets, dataset.city_n_nodes)
         )
-    n_pad = None if hetero else node_pad_target(cfg, dataset.n_nodes)
+        n_pad, node_pad_arg = None, city_pads
+        padded_city_nodes = [
+            n + p for n, p in zip(dataset.city_n_nodes, city_pads)
+        ]
+    else:
+        n_pad = node_pad_target(cfg, dataset.n_nodes)
+        node_pad_arg = (n_pad - dataset.n_nodes) if n_pad is not None else 0
+        padded_city_nodes = [n_pad if n_pad is not None else dataset.n_nodes]
     model = build_model(
         cfg,
         dataset.n_feats,
         support_modes,
         shard_spec,
-        n_real_nodes=dataset.n_nodes if n_pad is not None else None,
+        n_real_nodes=dataset.n_nodes if not hetero and n_pad is not None else None,
     )
     if placement is not None and hasattr(placement, "check_divisibility"):
-        for n_nodes in dataset.city_n_nodes if hetero else [dataset.n_nodes]:
+        for n_nodes in padded_city_nodes:
             placement.check_divisibility(
-                cfg.train.batch_size,
-                n_pad if n_pad is not None else n_nodes,
-                m_graphs=cfg.model.m_graphs,
+                cfg.train.batch_size, n_nodes, m_graphs=cfg.model.m_graphs
             )
     t = cfg.train
     return Trainer(
         model,
         dataset,
         supports,
-        node_pad=(n_pad - dataset.n_nodes) if n_pad is not None else 0,
+        node_pad=node_pad_arg,
         lr=t.lr,
         weight_decay=t.weight_decay,
         loss=t.loss,

@@ -144,7 +144,7 @@ class Trainer:
         out_dir: str = "output",
         top_k: int = 1,
         prefetch: int = 1,
-        node_pad: int = 0,
+        node_pad=0,
         data_placement: str = "auto",
         async_checkpoint: bool = True,
         placement=None,
@@ -162,18 +162,32 @@ class Trainer:
         if prefetch < 0:
             raise ValueError("prefetch must be >= 0 (batches placed ahead)")
         self.prefetch = prefetch
-        if node_pad < 0:
+        hetero = getattr(dataset, "heterogeneous", False)
+        n_cities = getattr(dataset, "n_cities", 1)
+        if isinstance(node_pad, (tuple, list)):
+            pads = tuple(int(p) for p in node_pad)
+            if len(pads) != n_cities:
+                raise ValueError(
+                    f"node_pad sequence must list one pad per city "
+                    f"(n_cities={n_cities}), got {node_pad!r}"
+                )
+        else:
+            if node_pad and hetero:
+                raise ValueError(
+                    "heterogeneous cities have per-city region counts — "
+                    "node_pad must be a per-city sequence, not a scalar"
+                )
+            pads = (int(node_pad),) * n_cities
+        if min(pads) < 0:
             raise ValueError("node_pad must be >= 0 (padded node rows)")
-        if node_pad and getattr(dataset, "heterogeneous", False):
-            raise ValueError(
-                "node_pad is a single-target-N concept; heterogeneous "
-                "cities have per-city region counts (pad would need to be "
-                "per-city — shard such runs on dp/branch axes instead)"
-            )
-        #: extra zero nodes appended so N divides the mesh's region axis;
-        #: padded rows are isolated (zero supports), excluded from the gate
-        #: pooling (model.n_real_nodes) and masked out of the loss/metrics
-        self.node_pad = node_pad
+        #: extra zero nodes appended per city so N divides the mesh's
+        #: region axis; padded rows are isolated (zero supports), excluded
+        #: from the gate pooling (model.n_real_nodes / city_n_real) and
+        #: masked out of the loss/metrics
+        self._node_pads = pads
+        #: scalar for the homogeneous case (all cities share one pad);
+        #: per-city tuple otherwise
+        self.node_pad = pads[0] if len(set(pads)) == 1 else pads
         if data_placement not in ("auto", "resident", "stream"):
             raise ValueError(
                 f"data_placement must be auto|resident|stream, got {data_placement!r}"
@@ -236,9 +250,30 @@ class Trainer:
                     f"the {mode!r} split is empty — adjust split fractions/dates "
                     "or provide more data"
                 )
-        self.step_fns = make_step_fns(
-            model, make_optimizer(lr, weight_decay), loss, checks=checks
+        def _fresh_fns(mdl):
+            return make_step_fns(
+                mdl, make_optimizer(lr, weight_decay), loss, checks=checks
+            )
+
+        self._make_fns = _fresh_fns
+        self.step_fns = _fresh_fns(model)
+        # Per-city gate pooling under per-city node padding: cities with
+        # padded node rows need their own n_real_nodes (a static module
+        # attribute), so their steps close over a clone of the model. jit
+        # retraces per city shape anyway — this adds no compilations the
+        # heterogeneous path wasn't already paying. Derived here (not a
+        # parameter) so per-city pads can never silently pair with the
+        # base model's pooling divisor. Homogeneous padding instead sets
+        # n_real_nodes statically on the model itself (build_model).
+        self._city_n_real = (
+            tuple(
+                n if p else None
+                for n, p in zip(dataset.city_n_nodes, pads)
+            )
+            if hetero and any(pads)
+            else None
         )
+        self._city_fns: dict = {}
         example = next(dataset.batches("train", batch_size, pad_last=True))
         example_x, _, _ = self._place_batch(example, "train")  # node-padded when needed
         self.params, self.opt_state = self.step_fns.init(
@@ -367,6 +402,25 @@ class Trainer:
             return self.supports.for_city(batch.city)
         return self.supports
 
+    def _pad_for(self, city: int) -> int:
+        """Padded node rows appended to this city's arrays/supports."""
+        return self._node_pads[city]
+
+    def _fns(self, city: int):
+        """The step functions for a city's batches.
+
+        Cities whose node axis carries padding get steps closed over a
+        model clone with that city's ``n_real_nodes`` (the gate pooling
+        mean must divide by real nodes, not padded N).
+        """
+        if self._city_n_real is None or self._city_n_real[city] is None:
+            return self.step_fns
+        if city not in self._city_fns:
+            self._city_fns[city] = self._make_fns(
+                self.model.clone(n_real_nodes=self._city_n_real[city])
+            )
+        return self._city_fns[city]
+
     def _placed_batches(
         self, mode: str, *, shuffle: bool = False, with_arrays: bool | None = None
     ):
@@ -405,23 +459,24 @@ class Trainer:
 
     def _place_batch(self, batch, mode: str):
         sample_mask = (np.arange(len(batch)) < batch.n_real).astype(np.float32)
+        pad = self._pad_for(batch.city)
         if self._resident and batch.indices is not None:
             x_all, y_all = self._resident_arrays(mode, batch.city)
-            mask = self._mask(sample_mask, y_all.shape[y_all.ndim - 2])
+            mask = self._mask(sample_mask, y_all.shape[y_all.ndim - 2], pad)
             idx = jnp.asarray(batch.indices)  # a few hundred bytes, not the data
             return jnp.take(x_all, idx, axis=0), jnp.take(y_all, idx, axis=0), mask
-        mask = self._mask(sample_mask, batch.y.shape[batch.y.ndim - 2] + self.node_pad)
+        mask = self._mask(sample_mask, batch.y.shape[batch.y.ndim - 2] + pad, pad)
         bx, by = batch.x, batch.y
-        if self.node_pad:
-            bx = self._pad_nodes(bx, 2)  # (B,T,N,C)
-            by = self._pad_nodes(by, by.ndim - 2)  # (B,[H,]N,C)
+        if pad:
+            bx = self._pad_nodes(bx, 2, pad)  # (B,T,N,C)
+            by = self._pad_nodes(by, by.ndim - 2, pad)  # (B,[H,]N,C)
         return self.placement.put(bx, "x"), self.placement.put(by, "y"), mask
 
-    def _mask(self, sample_mask, n_padded_nodes: int):
+    def _mask(self, sample_mask, n_padded_nodes: int, pad: int):
         """Loss mask: samples, crossed with real-node rows when node-padded."""
-        if self.node_pad:
+        if pad:
             node_mask = (
-                np.arange(n_padded_nodes) < n_padded_nodes - self.node_pad
+                np.arange(n_padded_nodes) < n_padded_nodes - pad
             ).astype(np.float32)
             mask = sample_mask[:, None] * node_mask[None, :]
         else:
@@ -437,18 +492,19 @@ class Trainer:
                 if self.dataset.shared_graphs
                 else self.dataset.city_arrays(mode, city)
             )
-            if self.node_pad:
-                x = self._pad_nodes(x, 2)
-                y = self._pad_nodes(y, y.ndim - 2)
+            pad = self._pad_for(city)
+            if pad:
+                x = self._pad_nodes(x, 2, pad)
+                y = self._pad_nodes(y, y.ndim - 2, pad)
             self._resident_cache[key] = (
                 self.placement.put(x, "x"),
                 self.placement.put(y, "y"),
             )
         return self._resident_cache[key]
 
-    def _pad_nodes(self, arr, axis: int):
+    def _pad_nodes(self, arr, axis: int, pad: int):
         widths = [(0, 0)] * arr.ndim
-        widths[axis] = (0, self.node_pad)
+        widths[axis] = (0, pad)
         return np.pad(arr, widths)
 
     def _run_epoch(self, mode: str, train: bool) -> float:
@@ -463,12 +519,13 @@ class Trainer:
             mode, shuffle=self.shuffle and train
         ):
             sup = self._supports_for(batch)
+            fns = self._fns(batch.city)
             if train:
-                self.params, self.opt_state, loss = self.step_fns.train_step(
+                self.params, self.opt_state, loss = fns.train_step(
                     self.params, self.opt_state, sup, x, y, mask
                 )
             else:
-                loss, _ = self.step_fns.eval_step(self.params, sup, x, y, mask)
+                loss, _ = fns.eval_step(self.params, sup, x, y, mask)
             losses.append(loss)
             counts.append(batch.n_real)
         if not counts:
@@ -629,12 +686,13 @@ class Trainer:
             preds, trues = {}, {}  # per-city accumulation (one key unless hetero)
             # metric accumulation reads batch.y on the host — keep arrays
             for batch, (x, y, mask) in self._placed_batches(mode, with_arrays=True):
-                _, pred = self.step_fns.eval_step(
+                _, pred = self._fns(batch.city).eval_step(
                     params, self._supports_for(batch), x, y, mask
                 )
                 pred = np.asarray(pred)[: batch.n_real]
-                if self.node_pad:  # drop padded node rows ((B,[H,]N,C))
-                    pred = pred[..., : -self.node_pad, :]
+                pad = self._pad_for(batch.city)
+                if pad:  # drop padded node rows ((B,[H,]N,C))
+                    pred = pred[..., :-pad, :]
                 preds.setdefault(batch.city, []).append(pred)
                 trues.setdefault(batch.city, []).append(batch.y[: batch.n_real])
             if hetero:

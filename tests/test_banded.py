@@ -230,6 +230,7 @@ class TestRouting:
         with pytest.raises(ValueError, match="bandwidth"):
             route_supports(cfg, ds)
 
+    @pytest.mark.slow
     def test_end_to_end_banded_training_matches_dense(self, mesh, tmp_path):
         """Banded-routed training reproduces dense-routed losses exactly.
 
@@ -259,6 +260,7 @@ class TestRouting:
             losses["banded"]["train"], losses["dense"]["train"], rtol=1e-5
         )
 
+    @pytest.mark.slow
     def test_banded_checkpoint_serves_single_device(self, mesh, tmp_path):
         """A banded-trained checkpoint rebuilds on one device via Forecaster
         (loop param layout is config-determined; supports passed dense)."""

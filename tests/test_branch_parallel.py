@@ -53,6 +53,7 @@ class TestMesh3Axis:
 
 class TestBranchParallelParity:
     @pytest.mark.parametrize("dp,region,branch", [(4, 1, 2), (2, 2, 2), (1, 1, 2)])
+    @pytest.mark.slow
     def test_training_trajectory_matches_single_device(
         self, eight_devices, dp, region, branch
     ):

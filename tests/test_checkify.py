@@ -31,6 +31,7 @@ def setup():
     return model, supports, x, y, mask
 
 
+@pytest.mark.slow
 def test_checked_step_matches_unchecked(setup):
     model, supports, x, y, mask = setup
     plain = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
@@ -42,6 +43,7 @@ def test_checked_step_matches_unchecked(setup):
     assert float(l0) == pytest.approx(float(l1), rel=1e-6)
 
 
+@pytest.mark.slow
 def test_checked_step_traps_nan(setup):
     model, supports, x, y, mask = setup
     checked = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse", checks="nan")

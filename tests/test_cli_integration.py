@@ -59,6 +59,7 @@ def _run_cli(args, timeout=900):
     return json.loads(line)
 
 
+@pytest.mark.slow
 def test_cli_npz_date_flow_matches_direct_synthetic(archive, tmp_path):
     """``--data data_dict.npz -date ... -cpt ...`` == the in-process run
     on the identical synthetic data (same seed, same recipe)."""
@@ -96,6 +97,7 @@ def test_cli_npz_date_flow_matches_direct_synthetic(archive, tmp_path):
             )
 
 
+@pytest.mark.slow
 def test_cli_test_only_reuses_checkpoint(archive, tmp_path):
     """``--test-only`` re-scores the trained checkpoint (Main.py's -test
     path) without retraining — metrics match the training run's report."""

@@ -47,6 +47,7 @@ def test_quadratic_node_term_grows_with_n():
     assert quad_share(2500) > 0.3
 
 
+@pytest.mark.slow
 def test_flops_against_jax_cost_analysis():
     """Analytic forward FLOPs within ~2x of XLA's own cost analysis.
 

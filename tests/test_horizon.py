@@ -113,6 +113,7 @@ class TestHorizonOnMesh:
         )
         np.testing.assert_allclose(float(loss_mesh), float(loss_single), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_longhorizon_trains_on_banded_mesh_with_padding(self, tmp_path):
         """Seq2seq (4-D targets) x banded routing x node padding compose:
         the longhorizon preset on a (dp=4, region=2) mesh at N=25 -> 26."""

@@ -82,6 +82,7 @@ class TestPadTarget:
 
 
 class TestPaddedTrainingParity:
+    @pytest.mark.slow
     def test_padded_mesh_matches_unpadded_single_device(self, eight_devices, tmp_path):
         """The headline contract: identical loss trajectory (and the scaled
         preset's literal region=8 config becomes trainable at any N)."""
@@ -116,6 +117,7 @@ class TestPaddedTrainingParity:
                 res["test"][metric], res1["test"][metric], rtol=1e-4
             )
 
+    @pytest.mark.slow
     def test_padded_sparse_mesh_trains(self, eight_devices, tmp_path):
         cfg = _cfg(sparse=True, strategy="gspmd")
         cfg.train.out_dir = str(tmp_path)

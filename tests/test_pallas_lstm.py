@@ -35,6 +35,7 @@ def test_pallas_matches_scan(data, layers):
         np.testing.assert_allclose(np.asarray(gc), np.asarray(wc), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pallas_gradients_match_scan(data):
     base = StackedLSTM(hidden_dim=8, num_layers=3)
     pallas = StackedLSTM(hidden_dim=8, num_layers=3, backend="pallas")
@@ -57,6 +58,7 @@ def test_pallas_gradients_match_scan(data):
     )
 
 
+@pytest.mark.slow
 def test_pallas_input_gradient_matches(data):
     base = StackedLSTM(hidden_dim=8, num_layers=2)
     pallas = StackedLSTM(hidden_dim=8, num_layers=2, backend="pallas")
@@ -95,6 +97,7 @@ def test_pallas_under_vmap(data):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_flagship_model_with_pallas_backend():
     """Full branch-vmapped ST-MGCN trains one step on the kernel path."""
     from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
@@ -132,6 +135,7 @@ def test_flagship_model_with_pallas_backend():
     assert float(loss_pallas) == pytest.approx(float(loss_base), rel=1e-5)
 
 
+@pytest.mark.slow
 def test_pallas_bf16(data):
     base = StackedLSTM(hidden_dim=8, num_layers=3, dtype=jnp.bfloat16)
     pallas = StackedLSTM(
@@ -147,6 +151,7 @@ def test_pallas_bf16(data):
     )
 
 
+@pytest.mark.slow
 def test_pallas_bf16_gradients(data):
     """bf16 backward path: the kernel rounds f32 cotangents/activations to
     bf16 before each MXU contraction (``_mm``) — new rounding that exists
@@ -207,6 +212,7 @@ class TestBlockSizing:
         assert fwd8 >= 8 and bwd8 >= 8 and fwd8 % bwd8 == 0
 
 
+@pytest.mark.slow
 def test_pallas_matches_scan_at_longhorizon_t24():
     """T=24, L=3 (the longhorizon preset's recurrence shape): the
     auto-narrowed blocks keep kernel math identical to the scan path."""

@@ -65,6 +65,7 @@ class TestShardedEquivalence:
         np.testing.assert_allclose(np.asarray(out), single, rtol=2e-5, atol=2e-6)
 
     @pytest.mark.parametrize("dp,region", [(8, 1), (4, 2)])
+    @pytest.mark.slow
     def test_train_trajectory_matches_single_device(self, eight_devices, dp, region):
         model, sup, x, y = setup_problem()
         fns = make_step_fns(model, make_optimizer(1e-2, 1e-4), "mse")
@@ -100,6 +101,7 @@ class TestShardedEquivalence:
             params_m, ref_params,
         )
 
+    @pytest.mark.slow
     def test_gradient_allreduce_semantics(self, eight_devices):
         """dp-sharded batch loss == mean over the full batch, so grads agree."""
         model, sup, x, y = setup_problem(B=8)
@@ -202,6 +204,7 @@ class TestHaloExchange:
 
 
 class TestEndToEndShardedTrainer:
+    @pytest.mark.slow
     def test_multicity_preset_trains_on_mesh(self, eight_devices, tmp_path):
         """Heterogeneous pair on the dp=8 mesh: batch axis shards, node
         axes stay whole, per-city shapes each get their own compiled step."""

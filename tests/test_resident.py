@@ -36,6 +36,7 @@ def _run(data_placement, rows=5, epochs=2, shuffle=False, **cfg_over):
 
 
 @pytest.mark.parametrize("shuffle", [False, True])
+@pytest.mark.slow
 def test_resident_matches_stream(shuffle):
     h_res, r_res = _run("resident", shuffle=shuffle)
     h_str, r_str = _run("stream", shuffle=shuffle)

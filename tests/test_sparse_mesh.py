@@ -40,6 +40,7 @@ class TestShardedSpmmApply:
             np.asarray(got), np.einsum("kij,bjf->kbif", mats, x), rtol=1e-4, atol=1e-4
         )
 
+    @pytest.mark.slow
     def test_gradient_matches_dense(self, mesh):
         mats = make_supports()
         x = np.random.default_rng(2).standard_normal((4, 256, 3)).astype(np.float32)
@@ -112,6 +113,7 @@ class TestSparseMeshTrainer:
             cfg.mesh.region_strategy = "gspmd"
         return cfg
 
+    @pytest.mark.slow
     def test_sparse_mesh_training_matches_single_device(self, mesh, tmp_path):
         """VERDICT round-1 missing #4: sparse trains on the mesh with
         sharded-vs-single parity (identical loss trajectory)."""

@@ -151,6 +151,7 @@ class TestCheckpoint:
         out_b = model.apply(jax.tree.map(jnp.asarray, params_l), sup, x)
         np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_async_writes_identical_files_and_surfaces_errors(self, tmp_path):
         """Async checkpointing is a pure IO-scheduling change: byte-identical
         files vs sync mode, and worker failures surface at flush."""
@@ -248,6 +249,7 @@ class TestTrainer:
         # five improvements (epochs 1-5); only the two best snapshots remain
         assert [os.path.basename(p) for p in kept] == ["best_e4.ckpt", "best_e5.ckpt"]
 
+    @pytest.mark.slow
     def test_resume_continues_epoch_count(self, tmp_path):
         tr = small_trainer(tmp_path, epochs=2)
         tr.train()
@@ -258,6 +260,7 @@ class TestTrainer:
         assert len(hist["train"]) == 2  # epochs 3 and 4 only
         assert tr2.epoch == 4
 
+    @pytest.mark.slow
     def test_same_seed_reproduces_trajectory(self, tmp_path):
         # shuffle=True exercises the seeded (seed, epoch) permutation stream —
         # the path a reproducibility regression would actually hit
@@ -313,6 +316,7 @@ class TestConfigAndExperiment:
         x, y = ds.arrays("train")
         assert x.shape[0] == ds.mode_size("train")
 
+    @pytest.mark.slow
     def test_multicity_percity_graphs_train_end_to_end(self, tmp_path):
         """BASELINE config 4 with *different* adjacencies per city: supports
         become a CitySupports and the trainer applies the right stack per
@@ -338,6 +342,7 @@ class TestConfigAndExperiment:
         assert np.isfinite(hist["train"]).all()
         assert np.isfinite(tr.test(modes=("test",))["test"]["rmse"])
 
+    @pytest.mark.slow
     def test_prefetch_does_not_change_results(self, tmp_path):
         """Placement lookahead is a pure pipelining change: identical loss
         trajectories with prefetch disabled, default, and deep."""

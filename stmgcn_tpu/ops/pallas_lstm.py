@@ -123,28 +123,38 @@ def _mm(a, w):
     return jnp.dot(a.astype(w.dtype), w, preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(T, L, xp_ref, wh_ref, wx_ref, b_ref, out_ref, hseq_ref, cseq_ref):
+def _fwd_kernel(T, L, xp_ref, wh0_ref, wxh_ref, b_ref, out_ref, hseq_ref, cseq_ref):
     """Whole T x L recurrence for one row block; states never leave VMEM.
 
-    Ref layouts (block shapes): ``xp (T, br, 4H)``, ``wh (L, H, 4H)``,
-    ``wx/b`` stacked layer weights, ``out (T, br, H)``,
-    ``hseq/cseq (T, L, br, H)`` — all sequence refs time-major so every
-    access below slices leading axes only.
+    Ref layouts (block shapes): ``xp (T, br, 4H)``, ``wh0 (H, 4H)``
+    (layer 0's recurrent weights), ``wxh (max(L-1,1), 2H, 4H)`` (layers
+    >= 1: input weights stacked over recurrent weights along the
+    contraction axis), ``b`` stacked layer >= 1 biases, ``out
+    (T, br, H)``, ``hseq/cseq (T, L, br, H)`` — all sequence refs
+    time-major so every access below slices leading axes only.
+
+    MXU shape note: layers >= 1 contract ``[h_below, h_prev] @ wxh`` as
+    ONE ``(br, 2H) x (2H, 4H)`` matmul. At the flagship's H=64 that puts
+    K=128 on the MXU's 128-lane contraction axis — two separate K=64
+    matmuls (the naive ``h_below @ wx + h_prev @ wh``) each run the
+    systolic array at half K-occupancy for the same total tile count.
+    Layer 0's recurrence is unavoidably K=H (its input term ``xp`` is
+    precomputed outside the kernel, where it batches over R*T rows).
     """
     br = xp_ref.shape[1]
-    h_dim = wh_ref.shape[1]
+    h_dim = wh0_ref.shape[0]
     f32 = jnp.float32
     h = [jnp.zeros((br, h_dim), f32) for _ in range(L)]
     c = [jnp.zeros((br, h_dim), f32) for _ in range(L)]
     for t in range(T):
         for layer in range(L):
             if layer == 0:
-                pre = xp_ref[t].astype(f32)
+                pre = xp_ref[t].astype(f32) + _mm(h[0], wh0_ref[...])
             else:
-                pre = _mm(h[layer - 1], wx_ref[layer - 1]) + b_ref[
+                hcat = jnp.concatenate([h[layer - 1], h[layer]], axis=-1)
+                pre = _mm(hcat, wxh_ref[layer - 1]) + b_ref[
                     layer - 1 : layer
                 ].astype(f32)
-            pre = pre + _mm(h[layer], wh_ref[layer])
             i, f, g, o = _cell_acts(pre)
             c[layer] = f * c[layer] + i * g
             h[layer] = o * jnp.tanh(c[layer])
@@ -157,8 +167,8 @@ def _bwd_kernel(
     T,
     L,
     xp_ref,
-    wh_ref,
-    wx_ref,
+    wh0_ref,
+    wxh_ref,
     b_ref,
     hseq_ref,
     cseq_ref,
@@ -166,23 +176,32 @@ def _bwd_kernel(
     ghfin_ref,
     gcfin_ref,
     dxp_ref,
-    dwh_ref,
-    dwx_ref,
+    dwh0_ref,
+    dwxh_ref,
     db_ref,
 ):
-    """Reverse sweep for one row block; gate pre-activations recomputed."""
+    """Reverse sweep for one row block; gate pre-activations recomputed.
+
+    Mirrors the forward's packed layout (see ``_fwd_kernel``): layers
+    >= 1 run ONE ``(br, 4H) x (4H, 2H)`` cotangent matmul (full K=4H,
+    N=2H=128 at the flagship width) and ONE ``(2H, br) x (br, 4H)``
+    weight-gradient matmul per step, where the unpacked form needed two
+    of each at half MXU occupancy. The ``(br, 2H)`` products split on
+    the lane axis at H — an aligned half-register slice.
+    """
     br = xp_ref.shape[1]
+    h_dim = wh0_ref.shape[0]
     f32 = jnp.float32
 
     @pl.when(pl.program_id(0) == 0)
     def _zero_weight_grads():
-        dwh_ref[...] = jnp.zeros_like(dwh_ref)
-        dwx_ref[...] = jnp.zeros_like(dwx_ref)
+        dwh0_ref[...] = jnp.zeros_like(dwh0_ref)
+        dwxh_ref[...] = jnp.zeros_like(dwxh_ref)
         db_ref[...] = jnp.zeros_like(db_ref)
 
     dh = [ghfin_ref[layer].astype(f32) for layer in range(L)]
     dc = [gcfin_ref[layer].astype(f32) for layer in range(L)]
-    zeros = jnp.zeros((br, wh_ref.shape[1]), f32)
+    zeros = jnp.zeros((br, h_dim), f32)
     for t in reversed(range(T)):
         dh[L - 1] = dh[L - 1] + gout_ref[t].astype(f32)
         for layer in reversed(range(L)):
@@ -191,13 +210,13 @@ def _bwd_kernel(
             c_t = cseq_ref[t, layer].astype(f32)
             # recompute this step's pre-activations (cheaper than storing)
             if layer == 0:
-                pre = xp_ref[t].astype(f32)
+                pre = xp_ref[t].astype(f32) + _mm(h_prev, wh0_ref[...])
             else:
                 below = hseq_ref[t, layer - 1].astype(f32)
-                pre = _mm(below, wx_ref[layer - 1]) + b_ref[
+                hcat = jnp.concatenate([below, h_prev], axis=-1)
+                pre = _mm(hcat, wxh_ref[layer - 1]) + b_ref[
                     layer - 1 : layer
                 ].astype(f32)
-            pre = pre + _mm(h_prev, wh_ref[layer])
             i, f, g, o = _cell_acts(pre)
             tc = jnp.tanh(c_t)
 
@@ -212,18 +231,20 @@ def _bwd_kernel(
                 ],
                 axis=-1,
             )
-            dh[layer] = _mm(dgates, wh_ref[layer].T)
             dc[layer] = dct * f
-            dwh_ref[layer] += _mm(h_prev.T.astype(xp_ref.dtype), dgates.astype(xp_ref.dtype)).astype(
-                dwh_ref.dtype
-            )
             if layer == 0:
+                dh[0] = _mm(dgates, wh0_ref[...].T)
+                dwh0_ref[...] += _mm(
+                    h_prev.T.astype(xp_ref.dtype), dgates.astype(xp_ref.dtype)
+                ).astype(dwh0_ref.dtype)
                 dxp_ref[t] = dgates.astype(dxp_ref.dtype)
             else:
-                dh[layer - 1] = dh[layer - 1] + _mm(dgates, wx_ref[layer - 1].T)
-                dwx_ref[layer - 1] += _mm(
-                    below.T.astype(xp_ref.dtype), dgates.astype(xp_ref.dtype)
-                ).astype(dwx_ref.dtype)
+                dcat = _mm(dgates, wxh_ref[layer - 1].T)  # (br, 2H)
+                dh[layer - 1] = dh[layer - 1] + dcat[:, :h_dim]
+                dh[layer] = dcat[:, h_dim:]
+                dwxh_ref[layer - 1] += _mm(
+                    hcat.T.astype(xp_ref.dtype), dgates.astype(xp_ref.dtype)
+                ).astype(dwxh_ref.dtype)
                 db_ref[layer - 1 : layer] += jnp.sum(
                     dgates, axis=0, keepdims=True
                 ).astype(db_ref.dtype)
@@ -260,6 +281,20 @@ def fused_lstm(x_proj0, wh_stack, wx_stack, b_stack):
     return out
 
 
+def _pack_weights(wh_stack, wx_stack):
+    """``(wh0, wxh)``: layer 0's recurrent weights alone, layers >= 1's
+    input and recurrent weights stacked along the contraction axis
+    (``(L-1, 2H, 4H)``; one garbage row when L == 1 so the operand is
+    never zero-sized) — the kernel then contracts ``[h_below, h_prev]``
+    against one K=2H operand per step."""
+    L = wh_stack.shape[0]
+    if L > 1:
+        wxh = jnp.concatenate([wx_stack[: L - 1], wh_stack[1:]], axis=1)
+    else:
+        wxh = jnp.concatenate([wx_stack, wx_stack], axis=1)
+    return wh_stack[0], wxh
+
+
 def _run_fwd(x_proj0, wh_stack, wx_stack, b_stack):
     R, T, four_h = x_proj0.shape
     L, h_dim, _ = wh_stack.shape
@@ -269,13 +304,14 @@ def _run_fwd(x_proj0, wh_stack, wx_stack, b_stack):
     rp = xp.shape[1]
     grid = (rp // block_fwd,)
     kernel = functools.partial(_fwd_kernel, T, L)
+    wh0, wxh = _pack_weights(wh_stack, wx_stack)
     out, hseq, cseq = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((T, block_fwd, four_h), lambda i: (0, i, 0)),
-            pl.BlockSpec((L, h_dim, four_h), lambda i: (0, 0, 0)),
-            pl.BlockSpec(wx_stack.shape, lambda i: (0, 0, 0)),
+            pl.BlockSpec((h_dim, four_h), lambda i: (0, 0)),
+            pl.BlockSpec(wxh.shape, lambda i: (0, 0, 0)),
             pl.BlockSpec(b_stack.shape, lambda i: (0, 0)),
         ],
         out_specs=[
@@ -289,7 +325,7 @@ def _run_fwd(x_proj0, wh_stack, wx_stack, b_stack):
             jax.ShapeDtypeStruct((T, L, rp, h_dim), dtype),
         ],
         interpret=not pallas_lstm_available(),
-    )(xp, wh_stack, wx_stack, b_stack)
+    )(xp, wh0, wxh, b_stack)
     return out, hseq, cseq, R
 
 
@@ -319,13 +355,14 @@ def _fused_bwd(residuals, cotangents):
     grid = (rp // block_bwd,)
     kernel = functools.partial(_bwd_kernel, T, L)
     f32 = jnp.float32
-    dxp, dwh, dwx, db = pl.pallas_call(
+    wh0, wxh = _pack_weights(wh_stack, wx_stack)
+    dxp, dwh0, dwxh, db = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((T, block_bwd, four_h), lambda i: (0, i, 0)),
-            pl.BlockSpec((L, h_dim, four_h), lambda i: (0, 0, 0)),
-            pl.BlockSpec(wx_stack.shape, lambda i: (0, 0, 0)),
+            pl.BlockSpec((h_dim, four_h), lambda i: (0, 0)),
+            pl.BlockSpec(wxh.shape, lambda i: (0, 0, 0)),
             pl.BlockSpec(b_stack.shape, lambda i: (0, 0)),
             pl.BlockSpec((T, L, block_bwd, h_dim), lambda i: (0, 0, i, 0)),
             pl.BlockSpec((T, L, block_bwd, h_dim), lambda i: (0, 0, i, 0)),
@@ -337,18 +374,27 @@ def _fused_bwd(residuals, cotangents):
             pl.BlockSpec((T, block_bwd, four_h), lambda i: (0, i, 0)),
             # weight grads: every grid step maps to the same block; the
             # sequential TPU grid makes read-modify-write accumulation safe
-            pl.BlockSpec((L, h_dim, four_h), lambda i: (0, 0, 0)),
-            pl.BlockSpec(wx_stack.shape, lambda i: (0, 0, 0)),
+            pl.BlockSpec((h_dim, four_h), lambda i: (0, 0)),
+            pl.BlockSpec(wxh.shape, lambda i: (0, 0, 0)),
             pl.BlockSpec(b_stack.shape, lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T, rp, four_h), dtype),
-            jax.ShapeDtypeStruct(wh_stack.shape, f32),
-            jax.ShapeDtypeStruct(wx_stack.shape, f32),
+            jax.ShapeDtypeStruct((h_dim, four_h), f32),
+            jax.ShapeDtypeStruct(wxh.shape, f32),
             jax.ShapeDtypeStruct(b_stack.shape, f32),
         ],
         interpret=not pallas_lstm_available(),
-    )(xp, wh_stack, wx_stack, b_stack, hseq, cseq, gout, ghfin, gcfin)
+    )(xp, wh0, wxh, b_stack, hseq, cseq, gout, ghfin, gcfin)
+    # unpack: wxh rows 0:H are layer l's input weights (dwx), rows H:2H
+    # its recurrent weights (dwh); layer 0's recurrent grads stand alone
+    L_ = wh_stack.shape[0]
+    if L_ > 1:
+        dwh = jnp.concatenate([dwh0[None], dwxh[:, h_dim:, :]], axis=0)
+        dwx = dwxh[:, :h_dim, :]
+    else:
+        dwh = dwh0[None]
+        dwx = jnp.zeros_like(wx_stack)
     return (
         dxp[:, :R].swapaxes(0, 1),
         dwh.astype(wh_stack.dtype),

@@ -43,6 +43,13 @@ from typing import Optional
 # 16x16 region grid, 12-step observation window, batch 64, full M=3 ST-MGCN.
 # Env overrides (STMGCN_BENCH_*) let the script's logic be validated on
 # slow hosts without changing the canonical TPU operating point.
+#: "canonical" measures the 16x16 flagship point; "scaled" measures
+#: BASELINE config 3 (50x50 grid -> N=2500, K=3, bf16, batch 16) as a
+#: dense-vs-sparse support-representation table on one chip. Scaled runs
+#: persist their own last-good TPU evidence
+#: (benchmarks/tpu_scaled_last_good.json), which canonical records embed
+#: as ``scaled_tpu`` so the driver-captured record carries both stories.
+MODE = os.environ.get("STMGCN_BENCH_MODE", "canonical")
 ROWS = int(os.environ.get("STMGCN_BENCH_ROWS", 16))
 SERIAL, DAILY, WEEKLY = 10, 1, 1
 BATCH = int(os.environ.get("STMGCN_BENCH_BATCH", 64))
@@ -68,14 +75,18 @@ CUSTOM_SCHEDULE = (
 LSTM_HIDDEN, LSTM_LAYERS, GCN_HIDDEN, M_GRAPHS, K_SUPPORTS = 64, 3, 64, 3, 3
 #: any STMGCN_BENCH_* override moves the run off the canonical operating
 #: point (shape, iteration count, or schedule set) — such a run must never
-#: overwrite the canonical last-good TPU evidence. The watchdog/platform
-#: vars only tune backend *probing*, not the measurement, so they don't
-#: count (a platform other than tpu never reaches the write anyway).
+#: overwrite a last-good TPU evidence file (canonical or scaled). The
+#: watchdog/platform vars only tune backend *probing* and MODE only
+#: selects which operating point runs — none move the point itself, so
+#: they don't count (a platform other than tpu never reaches the writes).
 CANONICAL_POINT = not any(
     k.startswith("STMGCN_BENCH_")
-    and k not in ("STMGCN_BENCH_WATCHDOG", "STMGCN_BENCH_PLATFORM")
+    and k
+    not in ("STMGCN_BENCH_WATCHDOG", "STMGCN_BENCH_PLATFORM", "STMGCN_BENCH_MODE")
     for k in os.environ
 )
+#: evidence files live next to the baseline anchor
+BENCH_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks")
 
 
 def _emit(record: dict) -> None:
@@ -141,20 +152,12 @@ def _measure(
     ~68 ms tunnel round-trip that is not the device's cost. See
     ``stmgcn_tpu/utils/profiling.py``.
     """
-    import jax
     import jax.numpy as jnp
 
     from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
     from stmgcn_tpu.models import STMGCN
     from stmgcn_tpu.ops import SupportConfig
     from stmgcn_tpu.train import make_optimizer, make_step_fns
-    from stmgcn_tpu.utils import (
-        device_peak_flops,
-        mfu,
-        region_timesteps_per_sec,
-        stmgcn_step_flops,
-        time_chained,
-    )
 
     seq_len = SERIAL + DAILY + WEEKLY
     data = synthetic_dataset(rows=ROWS, n_timesteps=24 * 7 * 2 + 4 * BATCH, seed=0)
@@ -180,18 +183,8 @@ def _measure(
     x = jnp.asarray(batch.x)
     y = jnp.asarray(batch.y)
     mask = jnp.ones(BATCH, jnp.float32)
-    params, opt_state = fns.init(jax.random.key(0), sup, x)
-
-    state = {"params": params, "opt_state": opt_state, "loss": None}
-
-    def step():
-        state["params"], state["opt_state"], state["loss"] = fns.train_step(
-            state["params"], state["opt_state"], sup, x, y, mask
-        )
-        return state["loss"]
-
-    step_s = time_chained(step, iters=iters, warmup=warmup)
-    flops = stmgcn_step_flops(
+    return _run_leg(
+        fns, sup, x, y, mask, warmup, iters,
         batch=BATCH,
         seq_len=seq_len,
         n_nodes=dataset.n_nodes,
@@ -202,10 +195,39 @@ def _measure(
         lstm_num_layers=LSTM_LAYERS,
         gcn_hidden_dim=GCN_HIDDEN,
     )
+
+
+def _run_leg(fns, sup, x, y, mask, warmup, iters, **flops_kwargs) -> dict:
+    """Time one training-step leg (chained-steps methodology, see
+    ``_measure``) and assemble its throughput/MFU record. Shared by the
+    canonical and scaled modes so the timing methodology cannot diverge."""
+    from stmgcn_tpu.utils import (
+        device_peak_flops,
+        mfu,
+        region_timesteps_per_sec,
+        stmgcn_step_flops,
+        time_chained,
+    )
+    import jax
+
+    params, opt_state = fns.init(jax.random.key(0), sup, x)
+    state = {"params": params, "opt_state": opt_state, "loss": None}
+
+    def step():
+        state["params"], state["opt_state"], state["loss"] = fns.train_step(
+            state["params"], state["opt_state"], sup, x, y, mask
+        )
+        return state["loss"]
+
+    step_s = time_chained(step, iters=iters, warmup=warmup)
+    flops = stmgcn_step_flops(**flops_kwargs)
     peak = device_peak_flops()
     util = mfu(flops, step_s, peak)
+    batch, seq_len, n_nodes = (
+        flops_kwargs["batch"], flops_kwargs["seq_len"], flops_kwargs["n_nodes"],
+    )
     return {
-        "value": round(region_timesteps_per_sec(BATCH, seq_len, dataset.n_nodes, step_s), 1),
+        "value": round(region_timesteps_per_sec(batch, seq_len, n_nodes, step_s), 1),
         "step_ms": round(step_s * 1e3, 3),
         "mfu": round(util, 4) if util is not None else None,
         "model_flops_per_step": flops,
@@ -214,7 +236,121 @@ def _measure(
     }
 
 
+def _measure_scaled(sparse: bool, warmup: int, iters: int) -> dict:
+    """BASELINE config 3's training step on one chip, dense or block-CSR
+    sparse supports (the N=2500 representation crossover — SURVEY.md §7
+    hard part 1). Built from ``preset("scaled")`` itself so the measured
+    config stays the shipped config (mesh forced single-device: this
+    script measures one chip; the sharded story is MULTICHIP's)."""
+    import jax
+    import jax
+    import jax.numpy as jnp
+
+    from stmgcn_tpu.config import preset
+    from stmgcn_tpu.experiment import build_dataset, build_model, build_supports
+    from stmgcn_tpu.train import make_optimizer, make_step_fns
+
+    cfg = preset("scaled")
+    cfg.data.rows = ROWS if "STMGCN_BENCH_ROWS" in os.environ else 50
+    if "STMGCN_BENCH_BATCH" in os.environ:
+        cfg.train.batch_size = BATCH
+    cfg.data.n_timesteps = 24 * 7 * 2 + 4 * cfg.train.batch_size
+    cfg.model.sparse = sparse
+    cfg.mesh.dp = cfg.mesh.region = 1
+    cfg.mesh.region_strategy = "gspmd"
+
+    dataset = build_dataset(cfg)
+    supports = build_supports(cfg, dataset)
+    model = build_model(cfg, dataset.n_feats)
+    fns = make_step_fns(model, make_optimizer(cfg.train.lr, cfg.train.weight_decay), "mse")
+    batch = next(dataset.batches("train", cfg.train.batch_size, pad_last=True))
+    sup = jax.tree.map(jnp.asarray, supports)
+    x, y = jnp.asarray(batch.x), jnp.asarray(batch.y)
+    mask = jnp.ones(cfg.train.batch_size, jnp.float32)
+    leg = _run_leg(
+        fns, sup, x, y, mask, warmup, iters,
+        batch=cfg.train.batch_size,
+        seq_len=cfg.data.seq_len,
+        n_nodes=dataset.n_nodes,
+        n_feats=dataset.n_feats,
+        m_graphs=cfg.model.m_graphs,
+        n_supports=cfg.model.n_supports,
+        lstm_hidden_dim=cfg.model.lstm_hidden_dim,
+        lstm_num_layers=cfg.model.lstm_num_layers,
+        gcn_hidden_dim=cfg.model.gcn_hidden_dim,
+    )
+    leg.update(
+        n_nodes=dataset.n_nodes,
+        batch=cfg.train.batch_size,
+        dtype=cfg.model.dtype,
+    )
+    return leg
+
+
+def _scaled_main(probe_err, native_tpu) -> None:
+    """Scaled-mode record: dense vs block-CSR sparse at BASELINE config 3.
+
+    Off-TPU the sparse leg is dropped entirely — its block-CSR SpMM would
+    run in Pallas interpret mode at N=2500 (orders of magnitude slow), the
+    same reason canonical mode drops its pallas leg — and the dense leg
+    runs with tiny warmup/iters. Measured legs key off ``native_tpu``, not
+    just the probe result: a host without the TPU plugin probes
+    *successfully* on CPU.
+    """
+    results, measure_err = {}, None
+    warmup, iters = (WARMUP, ITERS) if native_tpu else (1, 2)
+    reps = ("dense", "sparse") if native_tpu else ("dense",)
+    for rep in reps:
+        try:
+            results[rep] = _measure_scaled(rep == "sparse", warmup, iters)
+        except Exception as e:
+            measure_err = f"{rep}: {type(e).__name__}: {e}"
+            print(f"bench: scaled measurement failed for {measure_err}", file=sys.stderr)
+    if not results:
+        raise RuntimeError(measure_err or "no scaled configuration measured")
+    import jax
+
+    head = max(results, key=lambda k: results[k]["value"])
+    record = {
+        "metric": "region-timesteps/sec/chip",
+        "operating_point": "scaled-n2500",
+        "value": results[head]["value"],
+        "unit": "region-timesteps/s",
+        # the torch anchor exists only at the canonical 16x16 point; this
+        # record's comparison axis is dense-vs-sparse at N=2500
+        "vs_baseline": None,
+        "support_representation": head,
+        "step_ms": results[head]["step_ms"],
+        "mfu": results[head]["mfu"],
+        "device": jax.devices()[0].device_kind,
+        "variants": results,
+    }
+    if probe_err is not None:
+        record["platform"] = "cpu-fallback"
+        record["error"] = probe_err
+    elif measure_err is not None:
+        record["error"] = measure_err
+    path = os.path.join(BENCH_DIR, "tpu_scaled_last_good.json")
+    if native_tpu and len(results) == 2 and measure_err is None and CANONICAL_POINT:
+        # same rule as the canonical snapshot: a clean on-chip table AT THE
+        # SHIPPED OPERATING POINT (no STMGCN_BENCH_* shape/iter overrides)
+        # becomes evidence; anything else must not overwrite it
+        snapshot = dict(record)
+        snapshot["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        snapshot["measurement"] = {"warmup": warmup, "iters": iters}
+        try:
+            with open(path, "w") as f:
+                json.dump(snapshot, f, indent=1)
+        except OSError as e:
+            print(f"bench: could not persist scaled last-good: {e}", file=sys.stderr)
+    _emit(record)
+
+
 def main() -> None:
+    if MODE not in ("canonical", "scaled"):
+        raise SystemExit(
+            f"STMGCN_BENCH_MODE must be canonical|scaled, got {MODE!r}"
+        )
     if DTYPE not in ("float32", "bfloat16", "both"):
         raise SystemExit(
             f"STMGCN_BENCH_DTYPE must be float32|bfloat16|both, got {DTYPE!r}"
@@ -248,6 +384,9 @@ def main() -> None:
 
         probed_backend = jax.default_backend()
     native_tpu = probe_err is None and probed_backend == "tpu"
+    if MODE == "scaled":
+        _scaled_main(probe_err, native_tpu)  # emits its record and exits
+        return
     if CUSTOM_SCHEDULE:
         schedules = {"custom": (LSTM_UNROLL, LSTM_FUSED, LSTM_BACKEND)}
     else:
@@ -289,9 +428,7 @@ def main() -> None:
     vs_baseline = None
     vs_baseline_fp32 = None
     baseline = None
-    baseline_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "baseline.json"
-    )
+    baseline_path = os.path.join(BENCH_DIR, "baseline.json")
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
             base = json.load(f)
@@ -359,9 +496,7 @@ def main() -> None:
     # benchmarks/tpu_last_good.json so a later wedged tunnel cannot erase
     # the round's TPU numbers; any non-TPU record carries the last good
     # on-chip table inline (with its own timestamp + device provenance).
-    last_good_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "tpu_last_good.json"
-    )
+    last_good_path = os.path.join(BENCH_DIR, "tpu_last_good.json")
     if native_tpu and results and measure_err is None and CANONICAL_POINT:
         # only a fully-clean on-chip run AT THE CANONICAL OPERATING POINT
         # becomes canonical evidence — a run with failed legs, or one with
@@ -387,6 +522,15 @@ def main() -> None:
                 record["last_good_tpu"] = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             print(f"bench: could not read last-good: {e}", file=sys.stderr)
+    # the scaled-point (N=2500 dense-vs-sparse) evidence rides along in
+    # every canonical record once a clean on-chip scaled run has landed
+    scaled_path = os.path.join(BENCH_DIR, "tpu_scaled_last_good.json")
+    if os.path.exists(scaled_path):
+        try:
+            with open(scaled_path) as f:
+                record["scaled_tpu"] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench: could not read scaled last-good: {e}", file=sys.stderr)
     _emit(record)
 
 

@@ -80,9 +80,14 @@ LSTM_HIDDEN, LSTM_LAYERS, GCN_HIDDEN, M_GRAPHS, K_SUPPORTS = 64, 3, 64, 3, 3
 #: selects which operating point runs — none move the point itself, so
 #: they don't count (a platform other than tpu never reaches the writes).
 CANONICAL_POINT = not any(
-    k.startswith("STMGCN_BENCH_")
-    and k
-    not in ("STMGCN_BENCH_WATCHDOG", "STMGCN_BENCH_PLATFORM", "STMGCN_BENCH_MODE")
+    (
+        k.startswith("STMGCN_BENCH_")
+        and k
+        not in ("STMGCN_BENCH_WATCHDOG", "STMGCN_BENCH_PLATFORM", "STMGCN_BENCH_MODE")
+    )
+    # Pallas block-size knobs (ops/pallas_lstm.py) are schedule overrides
+    # too — a block-sweep leftover must not become canonical evidence
+    or k.startswith("STMGCN_PALLAS_")
     for k in os.environ
 )
 #: evidence files live next to the baseline anchor
@@ -388,6 +393,20 @@ def main() -> None:
         _scaled_main(probe_err, native_tpu)  # emits its record and exits
         return
     if CUSTOM_SCHEDULE:
+        if LSTM_BACKEND == "pallas" and not native_tpu:
+            # interpret-mode pallas at the canonical shapes never finishes;
+            # emit a parsable refusal instead of hanging the caller
+            _emit(
+                {
+                    "metric": "region-timesteps/sec/chip",
+                    "value": 0.0,
+                    "unit": "region-timesteps/s",
+                    "vs_baseline": None,
+                    "error": "STMGCN_BENCH_LSTM_BACKEND=pallas needs a real "
+                    f"TPU (resolved backend: {probed_backend!r}); the kernel "
+                    "would run in interpret mode here",
+                }
+            )
         schedules = {"custom": (LSTM_UNROLL, LSTM_FUSED, LSTM_BACKEND)}
     else:
         schedules = {

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""On-chip row-block sweep for the fused Pallas LSTM (TPU only).
+
+Measures the FULL flagship training step with ``lstm_backend="pallas"``
+across forward/backward row-block sizes (``STMGCN_PALLAS_FWD_ROWS`` /
+``STMGCN_PALLAS_BWD_ROWS`` env knobs read by ``ops/pallas_lstm.py``),
+plus the tuned XLA scan as the line to beat. One JSON line per point.
+
+The sweep restarts a fresh subprocess per point: the block sizes are
+read at trace time, so they must be set before the kernel is traced,
+and a wedged tunnel must not take the whole sweep down with it.
+
+Usage: python benchmarks/pallas_block_sweep.py [dtype]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+POINTS = [
+    # (fwd_rows, bwd_rows); None = the derived default
+    (None, None),
+    (128, 64),
+    (128, 128),
+    (256, 256),
+    (512, 128),
+    (512, 256),
+]
+
+
+def main() -> None:
+    dtype = sys.argv[1] if len(sys.argv) > 1 else "bfloat16"
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+
+    # caller-exported block overrides would silently retune every point
+    # (including the 'auto' one) — each point fully owns these knobs
+    base_env = {
+        k: v for k, v in os.environ.items() if not k.startswith("STMGCN_PALLAS_")
+    }
+
+    # the line to beat: the tuned XLA scan at the same shapes
+    env = dict(
+        base_env,
+        STMGCN_BENCH_DTYPE=dtype,
+        STMGCN_BENCH_LSTM_FUSED="1",
+        STMGCN_BENCH_LSTM_UNROLL="0",
+    )
+    results.append(("xla-tuned", _run(here, env)))
+
+    for fwd, bwd in POINTS:
+        env = dict(
+            base_env,
+            STMGCN_BENCH_DTYPE=dtype,
+            STMGCN_BENCH_LSTM_BACKEND="pallas",
+        )
+        if fwd is not None:
+            env["STMGCN_PALLAS_FWD_ROWS"] = str(fwd)
+            env["STMGCN_PALLAS_BWD_ROWS"] = str(bwd)
+        results.append((f"pallas-{fwd or 'auto'}/{bwd or 'auto'}", _run(here, env)))
+
+    print("\n| leg | region-ts/s | step ms | mfu |")
+    print("|---|---|---|---|")
+    for name, r in results:
+        if r is None:
+            print(f"| {name} | failed | | |")
+            continue
+        print(f"| {name} | {r['value']} | {r['step_ms']} | {r.get('mfu')} |")
+
+
+def _run(repo_root: str, env: dict):
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo_root, "bench.py")],
+            env=env,
+            capture_output=True,
+            timeout=3000,
+            check=True,
+        )
+        rec = json.loads(out.stdout.decode().strip().splitlines()[-1])
+        print(json.dumps(rec), flush=True)
+        if rec.get("platform") == "cpu-fallback" or rec.get("value", 0) <= 0:
+            return None
+        return rec
+    except Exception as e:  # noqa: BLE001 — per-point isolation is the point
+        print(f"sweep point failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,11 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
     """(fwd_rows, bwd_rows) for a storage dtype of ``itemsize`` bytes and
     a ``T x L`` recurrence.
 
+    ``STMGCN_PALLAS_FWD_ROWS`` / ``STMGCN_PALLAS_BWD_ROWS`` override the
+    derived sizes (tuning knob for on-chip sweeps —
+    ``benchmarks/pallas_block_sweep.py``); the fwd/bwd divisibility
+    invariant below still applies and is asserted.
+
     Every VMEM-resident term scales as ``rows * T * (5 + 2L) * H``
     (``xp``+``out`` blocks plus the two ``(T, L, rows, H)`` residual
     blocks), so the row count derives from the measured-good calibration
@@ -84,6 +89,8 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
     ``fwd_rows``) with ``bwd_rows``-sized blocks, which is only correct
     when the forward block is an exact multiple of the backward block.
     """
+    import os
+
     base_fwd = 256 if itemsize <= 2 else 128
     min_rows = 16 if itemsize <= 2 else 8
     scale = (12 * (5 + 2 * 3)) / (T * (5 + 2 * L))
@@ -91,6 +98,8 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
     while fwd_rows > min_rows and fwd_rows > base_fwd * scale:
         fwd_rows //= 2
     bwd_rows = max(min_rows, fwd_rows // 2)
+    fwd_rows = int(os.environ.get("STMGCN_PALLAS_FWD_ROWS", fwd_rows))
+    bwd_rows = int(os.environ.get("STMGCN_PALLAS_BWD_ROWS", bwd_rows))
     assert fwd_rows % bwd_rows == 0, (fwd_rows, bwd_rows)
     return fwd_rows, bwd_rows
 

@@ -6,9 +6,12 @@ across forward/backward row-block sizes (``STMGCN_PALLAS_FWD_ROWS`` /
 ``STMGCN_PALLAS_BWD_ROWS`` env knobs read by ``ops/pallas_lstm.py``),
 plus the tuned XLA scan as the line to beat. One JSON line per point.
 
-The sweep restarts a fresh subprocess per point: the block sizes are
-read at trace time, so they must be set before the kernel is traced,
-and a wedged tunnel must not take the whole sweep down with it.
+The sweep runs a fresh ``bench.py`` subprocess per point: the block
+sizes are read at trace time, so they must be set before the kernel is
+traced, and a wedged tunnel must not take the whole sweep down with it.
+Points that did not measure on a real TPU (cpu-fallback, refusal
+records, hosts whose probe resolves to CPU) are reported failed —
+a CPU number must never pose as the line to beat.
 
 Usage: python benchmarks/pallas_block_sweep.py [dtype]
 """
@@ -17,8 +20,10 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from variants import run_bench  # noqa: E402 — the one bench-parsing contract
 
 POINTS = [
     # (fwd_rows, bwd_rows); None = the derived default
@@ -33,34 +38,34 @@ POINTS = [
 
 def main() -> None:
     dtype = sys.argv[1] if len(sys.argv) > 1 else "bfloat16"
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    results = []
-
     # caller-exported block overrides would silently retune every point
     # (including the 'auto' one) — each point fully owns these knobs
     base_env = {
         k: v for k, v in os.environ.items() if not k.startswith("STMGCN_PALLAS_")
     }
+    results = []
 
     # the line to beat: the tuned XLA scan at the same shapes
-    env = dict(
-        base_env,
-        STMGCN_BENCH_DTYPE=dtype,
-        STMGCN_BENCH_LSTM_FUSED="1",
-        STMGCN_BENCH_LSTM_UNROLL="0",
-    )
-    results.append(("xla-tuned", _run(here, env)))
+    results.append((
+        "xla-tuned",
+        _tpu_point(
+            {
+                "STMGCN_BENCH_DTYPE": dtype,
+                "STMGCN_BENCH_LSTM_FUSED": "1",
+                "STMGCN_BENCH_LSTM_UNROLL": "0",
+            },
+            base_env,
+        ),
+    ))
 
     for fwd, bwd in POINTS:
-        env = dict(
-            base_env,
-            STMGCN_BENCH_DTYPE=dtype,
-            STMGCN_BENCH_LSTM_BACKEND="pallas",
-        )
+        extra = {"STMGCN_BENCH_DTYPE": dtype, "STMGCN_BENCH_LSTM_BACKEND": "pallas"}
         if fwd is not None:
-            env["STMGCN_PALLAS_FWD_ROWS"] = str(fwd)
-            env["STMGCN_PALLAS_BWD_ROWS"] = str(bwd)
-        results.append((f"pallas-{fwd or 'auto'}/{bwd or 'auto'}", _run(here, env)))
+            extra["STMGCN_PALLAS_FWD_ROWS"] = str(fwd)
+            extra["STMGCN_PALLAS_BWD_ROWS"] = str(bwd)
+        results.append(
+            (f"pallas-{fwd or 'auto'}/{bwd or 'auto'}", _tpu_point(extra, base_env))
+        )
 
     print("\n| leg | region-ts/s | step ms | mfu |")
     print("|---|---|---|---|")
@@ -71,23 +76,16 @@ def main() -> None:
         print(f"| {name} | {r['value']} | {r['step_ms']} | {r.get('mfu')} |")
 
 
-def _run(repo_root: str, env: dict):
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(repo_root, "bench.py")],
-            env=env,
-            capture_output=True,
-            timeout=3000,
-            check=True,
-        )
-        rec = json.loads(out.stdout.decode().strip().splitlines()[-1])
-        print(json.dumps(rec), flush=True)
-        if rec.get("platform") == "cpu-fallback" or rec.get("value", 0) <= 0:
-            return None
-        return rec
-    except Exception as e:  # noqa: BLE001 — per-point isolation is the point
-        print(f"sweep point failed: {type(e).__name__}: {e}", file=sys.stderr)
+def _tpu_point(env_extra: dict, base_env: dict):
+    rec = run_bench(env_extra, base_env=base_env, timeout=3000)
+    print(json.dumps(rec), flush=True)
+    if rec.get("platform") == "cpu-fallback" or rec.get("value", 0) <= 0:
         return None
+    # the probe can succeed on CPU (plugin absent / pinned platform) with
+    # no error field — only a real TPU device_kind counts as a data point
+    if "tpu" not in str(rec.get("device", "")).lower():
+        return None
+    return rec
 
 
 if __name__ == "__main__":

@@ -37,8 +37,17 @@ EXTRA_VARIANTS = [
 ]
 
 
-def run_bench(env_extra: dict, tiny: bool) -> dict:
-    env = dict(os.environ)
+def run_bench(
+    env_extra: dict, tiny: bool = False, *, base_env: dict = None, timeout: float = None
+) -> dict:
+    """Run ``bench.py`` in a subprocess and parse its one-line record.
+
+    The single bench-stdout parsing contract — every sweep script
+    (this one, ``pallas_block_sweep.py``) goes through here. ``base_env``
+    replaces the inherited environment (callers that must strip
+    ambient overrides); ``timeout`` bounds the child.
+    """
+    env = dict(os.environ if base_env is None else base_env)
     env.update(env_extra)
     if tiny:
         env.update(
@@ -49,9 +58,16 @@ def run_bench(env_extra: dict, tiny: bool) -> dict:
             STMGCN_BENCH_PLATFORM="cpu",
         )
     bench = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "bench.py")
-    out = subprocess.run(
-        [sys.executable, bench], env=env, capture_output=True, text=True
-    )
+    try:
+        out = subprocess.run(
+            [sys.executable, bench],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"bench timed out after {timeout}s"}
     line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
     try:
         return json.loads(line)

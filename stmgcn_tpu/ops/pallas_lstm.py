@@ -100,7 +100,15 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
     bwd_rows = max(min_rows, fwd_rows // 2)
     fwd_rows = int(os.environ.get("STMGCN_PALLAS_FWD_ROWS", fwd_rows))
     bwd_rows = int(os.environ.get("STMGCN_PALLAS_BWD_ROWS", bwd_rows))
-    assert fwd_rows % bwd_rows == 0, (fwd_rows, bwd_rows)
+    if fwd_rows % bwd_rows:
+        # user input now, not derived-by-construction — and violating the
+        # invariant makes the backward re-tiling numerically wrong, not
+        # slow, so it must survive python -O (no bare assert)
+        raise ValueError(
+            f"STMGCN_PALLAS_FWD_ROWS ({fwd_rows}) must be a multiple of "
+            f"STMGCN_PALLAS_BWD_ROWS ({bwd_rows}): the backward pass "
+            "re-tiles the forward-padded residuals"
+        )
     return fwd_rows, bwd_rows
 
 

@@ -191,6 +191,24 @@ class TestHeteroTraining:
         assert meta["normalizers"][0] != meta["normalizers"][1]
         assert meta["derived"]["n_nodes"] == [16, 9]
 
+    @pytest.mark.slow
+    def test_hetero_branch_mesh_trains(self, tmp_path):
+        """Hetero cities x branch model parallelism: the M vmapped
+        branches shard over the branch axis while each city keeps its own
+        shapes/supports (dense GSPMD; no node padding needed)."""
+        import jax
+
+        if len(jax.devices()) < 6:
+            pytest.skip("needs 6 virtual devices")
+        cfg = _pair_cfg(tmp_path, epochs=1)
+        cfg.mesh.dp, cfg.mesh.branch = 2, 3
+        tr = build_trainer(cfg, verbose=False)
+        hist = tr.train()
+        assert np.isfinite(hist["train"][0])
+        res = tr.test(modes=("test",))["test"]
+        assert np.isfinite(res["rmse"])
+        assert set(res["per_city"]) == {"city0", "city1"}
+
     def test_hetero_rejects_scalar_node_pad(self, tmp_path):
         from stmgcn_tpu.train import Trainer
 

@@ -292,6 +292,23 @@ class TestConfigAndExperiment:
         with pytest.raises(ValueError):
             preset("nope")
 
+    def test_data_override_keeps_per_city_fields_consistent(self):
+        cfg = preset("multicity")
+        # overriding n_cities alone drops the now-mismatched tuples
+        cfg.data.override(n_cities=1)
+        assert cfg.data.city_rows is None and cfg.data.city_timesteps is None
+        # replacing them in the same call keeps the replacements
+        cfg2 = preset("multicity")
+        cfg2.data.override(n_cities=3, city_rows=(4, 3, 5))
+        assert cfg2.data.city_rows == (4, 3, 5)
+        assert cfg2.data.city_timesteps is None  # length-2 tuple dropped
+        # matching lengths survive untouched
+        cfg3 = preset("multicity")
+        cfg3.data.override(rows=4)
+        assert cfg3.data.city_rows == (12, 10)
+        with pytest.raises(AttributeError):
+            preset("multicity").data.override(no_such_field=1)
+
     def test_build_dataset_multicity(self):
         """The multicity preset is heterogeneous: per-city N/T/graphs."""
         cfg = preset("multicity")

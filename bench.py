@@ -157,6 +157,22 @@ def _measure(
     ~68 ms tunnel round-trip that is not the device's cost. See
     ``stmgcn_tpu/utils/profiling.py``.
     """
+    fns, sup, x, y, mask, flops_kwargs = build_canonical_step(
+        dtype, unroll=unroll, fused=fused, backend=backend
+    )
+    return _run_leg(fns, sup, x, y, mask, warmup, iters, **flops_kwargs)
+
+
+def build_canonical_step(
+    dtype: str, unroll: int = 1, fused: bool = False, backend: str = "xla"
+):
+    """The flagship train step's pieces at the canonical operating point.
+
+    Returns ``(fns, sup, x, y, mask, flops_kwargs)`` — the ONE
+    construction of the benchmark model/shapes, shared by this script's
+    legs and the decomposition/sweep tools under ``benchmarks/`` so they
+    can never measure a different model than the headline does.
+    """
     import jax.numpy as jnp
 
     from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
@@ -188,8 +204,7 @@ def _measure(
     x = jnp.asarray(batch.x)
     y = jnp.asarray(batch.y)
     mask = jnp.ones(BATCH, jnp.float32)
-    return _run_leg(
-        fns, sup, x, y, mask, warmup, iters,
+    flops_kwargs = dict(
         batch=BATCH,
         seq_len=seq_len,
         n_nodes=dataset.n_nodes,
@@ -200,6 +215,7 @@ def _measure(
         lstm_num_layers=LSTM_LAYERS,
         gcn_hidden_dim=GCN_HIDDEN,
     )
+    return fns, sup, x, y, mask, flops_kwargs
 
 
 def _run_leg(fns, sup, x, y, mask, warmup, iters, **flops_kwargs) -> dict:

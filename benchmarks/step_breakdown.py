@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Time-budget decomposition of the flagship training step (one chip).
+
+The analytic FLOPs model says the shared LSTM is ~93% of step FLOPs
+(``stmgcn_tpu/utils/flops.py``), but FLOPs don't decide wall-clock on a
+TPU — the MXU runs matmuls while the VPU runs the gate transcendentals
+and the HBM moves the scan's intermediates. This script times each
+component in isolation at the canonical operating point so the
+optimization target is measured, not guessed:
+
+- ``step/tuned`` and ``step/pallas``: the full train step (fwd+bwd+Adam)
+  under the tuned XLA scan and the fused Pallas kernel.
+- ``lstm/scan`` and ``lstm/pallas``: ONLY the M-branch LSTM recurrence
+  (value+grad of a scalar readout), same shapes the model runs
+  (``R = B*N`` rows folded, vmapped over M branches).
+- ``conv``: ONLY the fused K-support graph conv einsum (value+grad),
+  both conv sites' shapes.
+- ``gate``: ONLY the contextual-gate elementwise chain (value+grad) —
+  sigmoid/relu/tanh VPU work with trivial matmuls.
+
+Interpretation: if ``lstm/*`` ~= ``step/*`` the LSTM is the whole story;
+if ``lstm`` legs barely move between fp32/bf16 the recurrence is
+VPU/HBM-bound (the MXU would be ~2x faster in bf16); if
+``sum(parts) << step`` the un-timed glue (transposes, fusion boundaries)
+is the gap. One JSON line per measurement.
+
+Usage: python benchmarks/step_breakdown.py [dtype] (default bfloat16)
+Env: STMGCN_BENCH_{ROWS,BATCH,WARMUP,ITERS,PLATFORM} as in bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench as bench_mod  # noqa: E402 — the one canonical-point definition
+
+ROWS, BATCH = bench_mod.ROWS, bench_mod.BATCH
+WARMUP, ITERS = bench_mod.WARMUP, bench_mod.ITERS
+T = bench_mod.SERIAL + bench_mod.DAILY + bench_mod.WEEKLY
+H, L = bench_mod.LSTM_HIDDEN, bench_mod.LSTM_LAYERS
+M, K = bench_mod.M_GRAPHS, bench_mod.K_SUPPORTS
+GCN_HIDDEN = bench_mod.GCN_HIDDEN
+
+
+def _emit(name: str, dtype: str, step_s: float, extra=None) -> None:
+    rec = {"component": name, "dtype": dtype, "ms": round(step_s * 1e3, 3)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def measure_steps(dtype: str) -> None:
+    """Full train step, tuned scan vs pallas backend (on TPU) — built by
+    ``bench.build_canonical_step`` so this measures exactly the headline
+    model."""
+    import jax
+
+    from stmgcn_tpu.utils import time_chained
+
+    for sched, kwargs in (
+        ("tuned", dict(fused=True, unroll=0)),
+        ("pallas", dict(backend="pallas")),
+    ):
+        if kwargs.get("backend") == "pallas" and not _on_tpu():
+            continue
+        fns, sup, x, y, mask, fk = bench_mod.build_canonical_step(dtype, **kwargs)
+        params, opt_state = fns.init(jax.random.key(0), sup, x)
+        state = {"params": params, "opt_state": opt_state}
+
+        def step():
+            state["params"], state["opt_state"], loss = fns.train_step(
+                state["params"], state["opt_state"], sup, x, y, mask
+            )
+            return loss
+
+        s = time_chained(step, iters=ITERS, warmup=WARMUP)
+        _emit(f"step/{sched}", dtype, s, {"n_nodes": fk["n_nodes"], "batch": BATCH})
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def measure_lstm(dtype: str) -> None:
+    """The M-branch LSTM recurrence alone, scan vs pallas."""
+    import jax
+    import jax.numpy as jnp
+
+    from stmgcn_tpu.ops.lstm import StackedLSTM
+    from stmgcn_tpu.utils import time_chained
+
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    R = BATCH * ROWS * ROWS
+    x = jax.random.normal(jax.random.key(0), (M, R, T, GCN_HIDDEN), dt)
+
+    for name, kwargs in (
+        ("scan", dict(fused_scan=True, unroll=0)),
+        ("pallas", dict(backend="pallas")),
+    ):
+        if kwargs.get("backend") == "pallas" and not _on_tpu():
+            continue
+        mod = StackedLSTM(hidden_dim=H, num_layers=L, dtype=dt, **kwargs)
+        params = jax.vmap(lambda xb: mod.init(jax.random.key(1), xb))(x)
+
+        def loss(p, xb):
+            out, _ = jax.vmap(mod.apply)(p, xb)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        vg = jax.jit(jax.value_and_grad(loss))
+        state = {"g": None}
+
+        def step():
+            val, state["g"] = vg(params, x)
+            return val
+
+        s = time_chained(step, iters=ITERS, warmup=WARMUP)
+        _emit(f"lstm/{name}", dtype, s, {"rows": R})
+
+
+def measure_conv(dtype: str) -> None:
+    """The fused K-support conv einsum alone (both call sites' shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from stmgcn_tpu.utils import time_chained
+
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    N = ROWS * ROWS
+    sup = jax.random.normal(jax.random.key(0), (M, K, N, N), dt) * 0.1
+    # site 1: temporal-as-feature (B, N, T); site 2: LSTM output (B, N, H)
+    for site, feat in (("conv/seq", T), ("conv/hidden", H)):
+        xb = jax.random.normal(jax.random.key(1), (M, BATCH, N, feat), dt)
+        w = jax.random.normal(jax.random.key(2), (M, K * feat, GCN_HIDDEN), dt) * 0.1
+
+        def loss(w, xb):
+            def one(sup_m, x_m, w_m):
+                kx = jnp.einsum("kij,bjf->bikf", sup_m, x_m)
+                kx = kx.reshape(kx.shape[0], kx.shape[1], -1)
+                return jnp.sum((kx @ w_m).astype(jnp.float32) ** 2)
+
+            return jnp.sum(jax.vmap(one)(sup, xb, w))
+
+        vg = jax.jit(jax.value_and_grad(loss))
+        state = {}
+
+        def step():
+            val, state["g"] = vg(w, xb)
+            return val
+
+        s = time_chained(step, iters=ITERS, warmup=WARMUP)
+        _emit(site, dtype, s, {"n_nodes": N, "feat": feat})
+
+
+def measure_gate(dtype: str) -> None:
+    """The contextual-gate elementwise chain alone (VPU-dominated)."""
+    import jax
+    import jax.numpy as jnp
+
+    from stmgcn_tpu.utils import time_chained
+
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    N = ROWS * ROWS
+    x = jax.random.normal(jax.random.key(0), (M, BATCH, T, N, 1), dt)
+    xh = jax.random.normal(jax.random.key(1), (M, BATCH, N, T), dt)
+    wf = jax.random.normal(jax.random.key(2), (M, T, T), dt) * 0.1
+
+    def loss(wf, x, xh):
+        def one(x_m, xh_m, w_m):
+            z = jnp.mean(jax.nn.relu(xh_m + xh_m), axis=1)  # (B, T) pool
+            s = jax.nn.sigmoid(jax.nn.relu(z @ w_m) @ w_m)
+            gated = jnp.einsum("btnf,bt->btnf", x_m, s)
+            return jnp.sum(gated.astype(jnp.float32) ** 2)
+
+        return jnp.sum(jax.vmap(one)(x, xh, wf))
+
+    vg = jax.jit(jax.value_and_grad(loss))
+    state = {}
+
+    def step():
+        val, state["g"] = vg(wf, x, xh)
+        return val
+
+    s = time_chained(step, iters=ITERS, warmup=WARMUP)
+    _emit("gate", dtype, s, {"n_nodes": N})
+
+
+def main() -> None:
+    dtype = sys.argv[1] if len(sys.argv) > 1 else "bfloat16"
+    pinned = os.environ.get("STMGCN_BENCH_PLATFORM")
+    if pinned:
+        from stmgcn_tpu.utils import force_host_platform
+
+        force_host_platform(pinned)
+    measure_steps(dtype)
+    measure_lstm(dtype)
+    measure_conv(dtype)
+    measure_gate(dtype)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,7 +68,14 @@ def run_bench(
         )
     except subprocess.TimeoutExpired:
         return {"error": f"bench timed out after {timeout}s"}
-    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
+    if not out.stdout.strip():
+        # a crashed child with nothing on stdout must surface its
+        # traceback, not parse as an empty record
+        return {
+            "error": f"bench exited {out.returncode} with no output: "
+            + out.stderr.strip()[-300:]
+        }
+    line = out.stdout.strip().splitlines()[-1]
     try:
         return json.loads(line)
     except json.JSONDecodeError:

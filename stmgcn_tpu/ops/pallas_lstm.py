@@ -100,6 +100,11 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
     bwd_rows = max(min_rows, fwd_rows // 2)
     fwd_rows = int(os.environ.get("STMGCN_PALLAS_FWD_ROWS", fwd_rows))
     bwd_rows = int(os.environ.get("STMGCN_PALLAS_BWD_ROWS", bwd_rows))
+    if fwd_rows < 1 or bwd_rows < 1:
+        raise ValueError(
+            "STMGCN_PALLAS_FWD_ROWS/BWD_ROWS must be positive, got "
+            f"{fwd_rows}/{bwd_rows}"
+        )
     if fwd_rows % bwd_rows:
         # user input now, not derived-by-construction — and violating the
         # invariant makes the backward re-tiling numerically wrong, not

@@ -1,0 +1,76 @@
+"""The bench.py driver contract, pinned by tests.
+
+bench.py is the one file the driver parses every round: it must print
+EXACTLY one JSON line and exit 0 on any environment trouble. A
+regression here silently costs a round its benchmark record (round 1
+lost its record to rc=2), so the contract gets the same regression
+protection as the model code. Runs at tiny shapes on pinned CPU via the
+same subprocess runner the sweep tools use.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmarks"),
+)
+from variants import run_bench as _run_bench  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+TINY = {
+    "STMGCN_BENCH_PLATFORM": "cpu",
+    "STMGCN_BENCH_ROWS": "4",
+    "STMGCN_BENCH_BATCH": "8",
+    "STMGCN_BENCH_WARMUP": "1",
+    "STMGCN_BENCH_ITERS": "2",
+}
+
+#: ambient STMGCN_* (sweep leftovers, tuning exports) must not leak into
+#: the children — these tests pin the contract, not the shell's state
+CLEAN_ENV = {k: v for k, v in os.environ.items() if not k.startswith("STMGCN_")}
+
+
+def run_bench(env_extra: dict, timeout: float) -> dict:
+    return _run_bench(env_extra, base_env=CLEAN_ENV, timeout=timeout)
+
+
+def test_canonical_record_shape():
+    rec = run_bench({**TINY, "STMGCN_BENCH_DTYPE": "float32"}, timeout=420)
+    assert rec.get("error") is None, rec
+    assert rec["metric"] == "region-timesteps/sec/chip"
+    assert rec["value"] > 0 and rec["unit"] == "region-timesteps/s"
+    # both XLA schedules measured even at the tiny point
+    assert set(rec["variants"]) == {"float32/plain", "float32/tuned"}
+    assert rec["baseline"]["value"] is not None  # anchor provenance embedded
+
+
+def test_scaled_mode_record():
+    rec = run_bench(
+        {**TINY, "STMGCN_BENCH_MODE": "scaled", "STMGCN_BENCH_ROWS": "6"},
+        timeout=420,
+    )
+    assert rec.get("error") is None, rec
+    assert rec["operating_point"] == "scaled-n2500"
+    # off-TPU only the dense leg runs (sparse would be interpret-mode)
+    assert set(rec["variants"]) == {"dense"}
+    assert rec["value"] > 0 and rec["vs_baseline"] is None
+
+
+def test_pallas_off_tpu_refuses_parsably():
+    rec = run_bench(
+        {**TINY, "STMGCN_BENCH_LSTM_BACKEND": "pallas"}, timeout=240
+    )
+    assert rec["value"] == 0.0
+    assert "pallas" in rec["error"] and "TPU" in rec["error"]
+
+
+def test_bad_dtype_fails_loudly():
+    """Invalid operator configuration must fail loudly, not fall back —
+    run_bench surfaces the child's nonzero exit as an error record."""
+    rec = run_bench({**TINY, "STMGCN_BENCH_DTYPE": "float64"}, timeout=240)
+    assert rec.get("error", "").startswith("bench exited"), rec
+    assert "value" not in rec  # no throughput number from a refused config

@@ -74,6 +74,14 @@ class StackedLSTM(nn.Module):
     unroll: int = 1
     #: run all layers inside one scan over time (see module docstring)
     fused_scan: bool = False
+    #: pack layers >= 1's two per-step matmuls into one K=2H contraction
+    #: inside the fused scan (fills the MXU's 128-lane K axis at H=64).
+    #: None = pack on TPU only (measured 4% slower on XLA:CPU, where the
+    #: per-step operand concat costs more than the split matmuls save);
+    #: True/False forces either form — numerics are equal either way, and
+    #: the forced-True form is equality-tested on CPU so the TPU-default
+    #: path is never dead code under the CPU test suite
+    fused_pack: Optional[bool] = None
     #: "xla" runs the scan paths above; "pallas" runs the whole T x L
     #: recurrence as one hand-written TPU kernel pair with VMEM-resident
     #: states and a recomputing backward (ops/pallas_lstm.py). Same
@@ -199,6 +207,27 @@ class StackedLSTM(nn.Module):
         wx0, _, b0 = params[0]
         x_proj0 = x @ wx0 + b0
 
+        # Layers >= 1 cannot hoist their input projection (it consumes the
+        # lower layer's fresh h), so their step does BOTH matmuls — packed
+        # into one [inp, h] @ [[wx], [wh]] contraction (K = 2H) so the
+        # MXU's 128-lane contraction axis is full at the flagship's H=64
+        # where two K=H matmuls would each run it half-empty. Same trick
+        # as the Pallas kernel (ops/pallas_lstm.py); weight concat happens
+        # at trace time, once. TPU only: on XLA:CPU the per-step operand
+        # concat costs more than the split matmuls save (measured 4%
+        # slower at the canonical bench point), so other backends keep
+        # the two-matmul form — numerics are equal either way (summation
+        # order differs at ulp level; pinned by tests/test_lstm_variants).
+        pack = (
+            self.fused_pack
+            if self.fused_pack is not None
+            else jax.default_backend() == "tpu"
+        )
+        wxh = [
+            jnp.concatenate([params[layer][0], params[layer][1]], axis=0)
+            for layer in range(1, self.num_layers)
+        ] if pack else None
+
         if initial_states is not None:
             states = tuple(tuple(s) for s in initial_states)
         else:
@@ -211,6 +240,11 @@ class StackedLSTM(nn.Module):
             for layer, (h, c) in enumerate(carry):
                 if layer == 0:
                     gates = xt0 + h @ params[0][1]
+                elif pack:
+                    gates = (
+                        jnp.concatenate([inp, h], axis=-1) @ wxh[layer - 1]
+                        + params[layer][2]
+                    )
                 else:
                     wx, wh, b = params[layer]
                     gates = inp @ wx + b + h @ wh

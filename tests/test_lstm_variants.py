@@ -28,6 +28,10 @@ def _out(model, params, x):
     dict(unroll=3), dict(unroll=12), dict(fused_scan=True),
     dict(fused_scan=True, unroll=4), dict(fused_scan=True, remat=True),
     dict(unroll=0), dict(fused_scan=True, unroll=0),  # 0 = full unroll
+    # the TPU-default packed K=2H contraction, forced on so the CPU
+    # suite executes it (off-TPU it would otherwise be dead code)
+    dict(fused_scan=True, fused_pack=True),
+    dict(fused_scan=True, fused_pack=True, unroll=0, remat=True),
 ])
 def test_variant_matches_default(data, variant):
     base = StackedLSTM(hidden_dim=8, num_layers=3)

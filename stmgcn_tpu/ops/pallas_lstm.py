@@ -34,7 +34,15 @@ transposes on ``(R, T, H)``-sized tensors.
 
 Zero initial state per call is the reference's semantics
 (``STMGCN.py:53-57``); callers that pass explicit initial states use the
-scan path instead. Numerics: elementwise cell arithmetic (gates,
+scan path instead.
+
+Mesh caveat: on a multi-chip mesh the kernel is a Mosaic custom call,
+and GSPMD's partitioning of custom calls is not validated here (this
+image exposes one real chip; the 8-virtual-device tests exercise the
+*interpret* lowering, which partitions as ordinary HLO). Multi-chip
+runs default to ``backend="xla"`` — the scan path shards on every mesh
+axis with tested loss parity — and should treat ``pallas`` on a mesh
+as experimental until measured on real multi-chip hardware. Numerics: elementwise cell arithmetic (gates,
 tanh/sigmoid, state updates) is float32 regardless of storage dtype, but
 matmul *operands* are kept in the storage dtype with f32 accumulation
 (``_mm``) — for bf16 storage that means f32-resident states and

@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr-schedule", choices=("none", "cosine"), default=None,
+                   help="constant lr (reference parity) or warmup+cosine "
+                        "decay sized to the full run")
+    p.add_argument("--warmup-epochs", type=float, default=None,
+                   help="linear warmup extent for --lr-schedule cosine")
+    p.add_argument("--min-lr-fraction", type=float, default=None,
+                   help="cosine floor as a fraction of --lr")
     p.add_argument("--weight-decay", type=float, default=None)
     p.add_argument("--loss", choices=("mse", "mae", "huber"), default=None)
     p.add_argument("--patience", type=int, default=None)
@@ -168,6 +175,8 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.data.n_timesteps = args.timesteps
     for field, attr in [
         ("epochs", "epochs"), ("batch_size", "batch_size"), ("lr", "lr"),
+        ("lr_schedule", "lr_schedule"), ("warmup_epochs", "warmup_epochs"),
+        ("min_lr_fraction", "min_lr_fraction"),
         ("weight_decay", "weight_decay"), ("loss", "loss"),
         ("patience", "patience"), ("top_k", "top_k"), ("seed", "seed"),
         ("checks", "checks"),

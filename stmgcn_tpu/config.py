@@ -148,6 +148,12 @@ class TrainConfig:
     batch_size: int = 32
     lr: float = 2e-3
     weight_decay: float = 1e-4
+    #: "none" (constant lr — reference parity, Main.py:13 has no
+    #: scheduler) | "cosine" (linear warmup then cosine decay to
+    #: lr * min_lr_fraction over the full run)
+    lr_schedule: str = "none"
+    warmup_epochs: float = 0.0
+    min_lr_fraction: float = 0.0
     loss: str = "mse"
     #: functional sanitizer (jax.experimental.checkify) on the train/eval
     #: steps: None | "nan" | "index" | "float" | "all" — fails at the step

@@ -374,6 +374,9 @@ def build_trainer(
         node_pad=node_pad_arg,
         lr=t.lr,
         weight_decay=t.weight_decay,
+        lr_schedule=t.lr_schedule,
+        warmup_epochs=t.warmup_epochs,
+        min_lr_fraction=t.min_lr_fraction,
         loss=t.loss,
         checks=t.checks,
         n_epochs=t.epochs,

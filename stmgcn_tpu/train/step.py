@@ -31,13 +31,70 @@ __all__ = ["StepFns", "make_optimizer", "make_step_fns"]
 LOSSES = ("mse", "mae", "huber")
 
 
-def make_optimizer(lr: float, weight_decay: float = 0.0) -> optax.GradientTransformation:
-    """Adam with L2 regularization, matching torch ``optim.Adam`` semantics."""
+def make_optimizer(
+    lr: float,
+    weight_decay: float = 0.0,
+    schedule: str = "none",
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    min_lr_fraction: float = 0.0,
+) -> optax.GradientTransformation:
+    """Adam with L2 regularization, matching torch ``optim.Adam`` semantics.
+
+    ``schedule`` extends the reference's fixed learning rate (``Main.py:13``
+    has no scheduler):
+
+    - ``"none"`` (default): constant ``lr`` — reference parity.
+    - ``"cosine"``: linear warmup over ``warmup_steps`` optimizer steps,
+      then cosine decay over ``decay_steps`` down to
+      ``lr * min_lr_fraction``. ``decay_steps`` must be set (the trainer
+      derives it from epochs x steps-per-epoch).
+
+    The L2 term stays *inside* the scheduled scaling (decay added to the
+    gradient before the Adam moments, then the whole update is scaled by
+    the current LR) — the same coupling torch's Adam(weight_decay=..)
+    has under external LR schedulers.
+    """
+    if not 0.0 <= min_lr_fraction <= 1.0:
+        # a negative floor would cross zero late in training and ascend
+        # the loss — silently corrupting the converged params
+        raise ValueError(
+            f"min_lr_fraction must be in [0, 1], got {min_lr_fraction}"
+        )
     parts = []
     if weight_decay:
         parts.append(optax.add_decayed_weights(weight_decay))
     parts.append(optax.scale_by_adam())
-    parts.append(optax.scale(-lr))
+    if schedule == "none":
+        if warmup_steps or min_lr_fraction:
+            # silently ignoring these would run constant-LR training while
+            # the user believes warmup/decay is active
+            raise ValueError(
+                "warmup_steps/min_lr_fraction only apply to "
+                "schedule='cosine' (got schedule='none' with "
+                f"warmup_steps={warmup_steps}, "
+                f"min_lr_fraction={min_lr_fraction})"
+            )
+        parts.append(optax.scale(-lr))
+    elif schedule == "cosine":
+        if decay_steps <= 0:
+            raise ValueError("schedule='cosine' needs decay_steps > 0")
+        if warmup_steps >= decay_steps:
+            raise ValueError(
+                f"warmup_steps ({warmup_steps}) must be shorter than the "
+                f"run (decay_steps={decay_steps}) — the schedule would "
+                "never leave warmup, let alone decay"
+            )
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps else lr,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=decay_steps,
+            end_value=lr * min_lr_fraction,
+        )
+        parts.append(optax.scale_by_schedule(lambda step: -sched(step)))
+    else:
+        raise ValueError(f"schedule must be none|cosine, got {schedule!r}")
     return optax.chain(*parts)
 
 

@@ -134,6 +134,9 @@ class Trainer:
         *,
         lr: float = 2e-3,
         weight_decay: float = 1e-4,
+        lr_schedule: str = "none",
+        warmup_epochs: float = 0.0,
+        min_lr_fraction: float = 0.0,
         loss: str = "mse",
         checks: Optional[str] = None,
         n_epochs: int = 100,
@@ -250,10 +253,22 @@ class Trainer:
                     f"the {mode!r} split is empty — adjust split fractions/dates "
                     "or provide more data"
                 )
+        # schedule steps are optimizer steps: warmup/decay extents derive
+        # from the dataset's actual per-epoch batch count, and the step
+        # counter lives in opt_state so --resume continues the schedule
+        # where the checkpoint left it
+        spe = self._train_steps_per_epoch()
+        optimizer = make_optimizer(
+            lr,
+            weight_decay,
+            schedule=lr_schedule,
+            warmup_steps=int(warmup_epochs * spe),
+            decay_steps=n_epochs * spe,
+            min_lr_fraction=min_lr_fraction,
+        )
+
         def _fresh_fns(mdl):
-            return make_step_fns(
-                mdl, make_optimizer(lr, weight_decay), loss, checks=checks
-            )
+            return make_step_fns(mdl, optimizer, loss, checks=checks)
 
         self._make_fns = _fresh_fns
         self.step_fns = _fresh_fns(model)
@@ -405,6 +420,22 @@ class Trainer:
     def _pad_for(self, city: int) -> int:
         """Padded node rows appended to this city's arrays/supports."""
         return self._node_pads[city]
+
+    def _train_steps_per_epoch(self) -> int:
+        """Optimizer steps per training epoch (sizes LR schedules).
+
+        Batches never mix cities, so per-city tail batches each count
+        (``pad_last`` fills them; the optimizer still steps once per
+        batch).
+        """
+        b = self.batch_size
+        ds = self.dataset
+        if getattr(ds, "heterogeneous", False):
+            return sum(-(-c.mode_size("train") // b) for c in ds.cities)
+        if ds.shared_graphs:
+            return -(-ds.mode_size("train") // b)
+        per_city = ds.mode_size("train") // ds.n_cities
+        return ds.n_cities * -(-per_city // b)
 
     def _fns(self, city: int):
         """The step functions for a city's batches.

@@ -73,6 +73,46 @@ class TestOptimizerParity:
             params = jax.tree.map(lambda p, u: p + u, params, updates)
         np.testing.assert_allclose(np.asarray(params["w"]), want, rtol=1e-5, atol=1e-6)
 
+    def test_cosine_schedule_warms_up_and_decays(self):
+        """Update magnitude follows warmup -> peak -> cosine floor; the
+        constant-gradient updates isolate the schedule from Adam."""
+        tx = make_optimizer(
+            1e-2, 0.0, schedule="cosine", warmup_steps=5, decay_steps=50,
+            min_lr_fraction=0.1,
+        )
+        params = {"w": jnp.zeros(3)}
+        state = tx.init(params)
+        g = {"w": jnp.ones(3)}
+        mags = []
+        for _ in range(50):
+            updates, state = tx.update(g, state, params)
+            mags.append(float(jnp.abs(updates["w"]).max()))
+        assert mags[0] < mags[4] < mags[5]          # linear warmup
+        assert mags[5] == max(mags)                 # peak right after warmup
+        assert mags[-1] < mags[5] * 0.2             # decayed near the floor
+        assert mags[-1] > 0                          # not to zero (floor 0.1)
+
+    def test_cosine_needs_decay_steps(self):
+        with pytest.raises(ValueError, match="decay_steps"):
+            make_optimizer(1e-2, schedule="cosine")
+        with pytest.raises(ValueError, match="schedule"):
+            make_optimizer(1e-2, schedule="linear")
+
+    def test_schedule_misconfigurations_raise(self):
+        # warmup/floor with schedule='none' would be silently ignored
+        with pytest.raises(ValueError, match="cosine"):
+            make_optimizer(1e-2, warmup_steps=5)
+        with pytest.raises(ValueError, match="cosine"):
+            make_optimizer(1e-2, min_lr_fraction=0.1)
+        # warmup at least as long as the run never decays
+        with pytest.raises(ValueError, match="warmup_steps"):
+            make_optimizer(1e-2, schedule="cosine", warmup_steps=50, decay_steps=50)
+        # a negative floor would cross zero into gradient ascent
+        with pytest.raises(ValueError, match="min_lr_fraction"):
+            make_optimizer(
+                1e-2, schedule="cosine", decay_steps=50, min_lr_fraction=-0.1
+            )
+
 
 def tiny_setup(seed=0, M=2, N=9, T=5, B=8):
     rng = np.random.default_rng(seed)
@@ -216,6 +256,40 @@ class TestTrainer:
         assert [l["epoch"] for l in lines] == [1, 2]
         meta, _, _ = load_checkpoint(tr.best_path)
         assert meta["normalizer"]["kind"] == "minmax"
+
+    @pytest.mark.slow
+    def test_cosine_schedule_trains_and_resumes_step_count(self, tmp_path):
+        """The schedule's step counter lives in opt_state, so --resume
+        continues the decay where the checkpoint left it."""
+        data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 60, seed=1)
+        dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+        from stmgcn_tpu.ops import SupportConfig
+
+        sup = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+        model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                       lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8)
+        kw = dict(n_epochs=2, batch_size=16, out_dir=str(tmp_path),
+                  lr_schedule="cosine", warmup_epochs=0.5,
+                  min_lr_fraction=0.05, verbose=False)
+        tr = Trainer(model, dataset, sup, **kw)
+        spe = tr._train_steps_per_epoch()
+        hist = tr.train()
+        assert np.isfinite(hist["train"]).all()
+        counts = [
+            int(leaf)
+            for leaf in jax.tree.leaves(tr.opt_state)
+            if np.ndim(leaf) == 0 and np.issubdtype(np.asarray(leaf).dtype, np.integer)
+        ]
+        assert 2 * spe in counts  # schedule stepped once per batch
+
+        restored = Trainer(model, dataset, sup, **kw)
+        restored.restore(tr.latest_path)
+        counts = [
+            int(leaf)
+            for leaf in jax.tree.leaves(restored.opt_state)
+            if np.ndim(leaf) == 0 and np.issubdtype(np.asarray(leaf).dtype, np.integer)
+        ]
+        assert 2 * spe in counts  # resume continues, not restarts, the decay
 
     def test_early_stopping_patience(self, tmp_path, monkeypatch):
         tr = small_trainer(tmp_path, epochs=50, patience=2)

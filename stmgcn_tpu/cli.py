@@ -58,6 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-lr-fraction", type=float, default=None,
                    help="cosine floor as a fraction of --lr")
     p.add_argument("--weight-decay", type=float, default=None)
+    p.add_argument("--grad-clip-norm", type=float, default=None,
+                   help="global-norm gradient clipping (off by default)")
     p.add_argument("--loss", choices=("mse", "mae", "huber"), default=None)
     p.add_argument("--patience", type=int, default=None)
     p.add_argument("--top-k", type=int, default=None,
@@ -177,7 +179,8 @@ def config_from_args(args) -> "ExperimentConfig":
         ("epochs", "epochs"), ("batch_size", "batch_size"), ("lr", "lr"),
         ("lr_schedule", "lr_schedule"), ("warmup_epochs", "warmup_epochs"),
         ("min_lr_fraction", "min_lr_fraction"),
-        ("weight_decay", "weight_decay"), ("loss", "loss"),
+        ("weight_decay", "weight_decay"), ("grad_clip_norm", "grad_clip_norm"),
+        ("loss", "loss"),
         ("patience", "patience"), ("top_k", "top_k"), ("seed", "seed"),
         ("checks", "checks"),
         ("out_dir", "out_dir"), ("data_placement", "data_placement"),

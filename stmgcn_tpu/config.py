@@ -154,6 +154,9 @@ class TrainConfig:
     lr_schedule: str = "none"
     warmup_epochs: float = 0.0
     min_lr_fraction: float = 0.0
+    #: global-norm gradient clipping before the L2 term and Adam moments
+    #: (None = off, reference parity)
+    grad_clip_norm: Optional[float] = None
     loss: str = "mse"
     #: functional sanitizer (jax.experimental.checkify) on the train/eval
     #: steps: None | "nan" | "index" | "float" | "all" — fails at the step

@@ -377,6 +377,7 @@ def build_trainer(
         lr_schedule=t.lr_schedule,
         warmup_epochs=t.warmup_epochs,
         min_lr_fraction=t.min_lr_fraction,
+        grad_clip_norm=t.grad_clip_norm,
         loss=t.loss,
         checks=t.checks,
         n_epochs=t.epochs,

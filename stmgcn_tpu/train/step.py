@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,8 +38,14 @@ def make_optimizer(
     warmup_steps: int = 0,
     decay_steps: int = 0,
     min_lr_fraction: float = 0.0,
+    grad_clip_norm: Optional[float] = None,
 ) -> optax.GradientTransformation:
     """Adam with L2 regularization, matching torch ``optim.Adam`` semantics.
+
+    ``grad_clip_norm`` prepends global-norm gradient clipping (the
+    ``torch.nn.utils.clip_grad_norm_`` idiom LSTM training commonly adds;
+    the reference has none) — clipping the raw gradient BEFORE the L2
+    term and Adam moments, matching where torch users call it.
 
     ``schedule`` extends the reference's fixed learning rate (``Main.py:13``
     has no scheduler):
@@ -62,6 +68,10 @@ def make_optimizer(
             f"min_lr_fraction must be in [0, 1], got {min_lr_fraction}"
         )
     parts = []
+    if grad_clip_norm is not None:
+        if grad_clip_norm <= 0:
+            raise ValueError(f"grad_clip_norm must be > 0, got {grad_clip_norm}")
+        parts.append(optax.clip_by_global_norm(grad_clip_norm))
     if weight_decay:
         parts.append(optax.add_decayed_weights(weight_decay))
     parts.append(optax.scale_by_adam())

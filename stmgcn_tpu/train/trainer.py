@@ -137,6 +137,7 @@ class Trainer:
         lr_schedule: str = "none",
         warmup_epochs: float = 0.0,
         min_lr_fraction: float = 0.0,
+        grad_clip_norm: Optional[float] = None,
         loss: str = "mse",
         checks: Optional[str] = None,
         n_epochs: int = 100,
@@ -265,6 +266,7 @@ class Trainer:
             warmup_steps=int(warmup_epochs * spe),
             decay_steps=n_epochs * spe,
             min_lr_fraction=min_lr_fraction,
+            grad_clip_norm=grad_clip_norm,
         )
 
         def _fresh_fns(mdl):

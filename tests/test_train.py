@@ -98,6 +98,23 @@ class TestOptimizerParity:
         with pytest.raises(ValueError, match="schedule"):
             make_optimizer(1e-2, schedule="linear")
 
+    def test_grad_clip_bounds_raw_gradient(self):
+        """Clipping applies to the RAW gradient (before L2/Adam): a huge
+        gradient produces the same update as its rescaled-to-norm copy."""
+        tx = make_optimizer(1e-2, 1e-4, grad_clip_norm=1.0)
+        params = {"w": jnp.ones(4)}
+        big = {"w": jnp.full(4, 100.0)}
+        small = {"w": jnp.full(4, 100.0) / jnp.linalg.norm(jnp.full(4, 100.0))}
+        s1 = tx.init(params)
+        u_big, _ = tx.update(big, s1, params)
+        s2 = tx.init(params)
+        u_small, _ = tx.update(small, s2, params)
+        np.testing.assert_allclose(
+            np.asarray(u_big["w"]), np.asarray(u_small["w"]), rtol=1e-6
+        )
+        with pytest.raises(ValueError, match="grad_clip_norm"):
+            make_optimizer(1e-2, grad_clip_norm=0.0)
+
     def test_schedule_misconfigurations_raise(self):
         # warmup/floor with schedule='none' would be silently ignored
         with pytest.raises(ValueError, match="cosine"):

@@ -19,6 +19,9 @@ Plans (the communication layer the reference lacks outright — SURVEY.md
                      (collective-permute), the rest GSPMD
 - ``region8-sparse`` block-CSR row strips per shard
 - ``branch3``        graph branches sharded; sum fusion becomes one psum
+- ``hetero-region``  heterogeneous city pair on a (dp, region) mesh with
+                     per-city node padding; reports the padded city's
+                     compiled step (each city shape compiles its own)
 
 Usage: python benchmarks/comm_table.py [rows] [batch]
 Emits one JSON line per plan plus a markdown table on stdout.
@@ -36,29 +39,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def build_plan(name: str, rows: int, batch: int):
     from stmgcn_tpu.config import preset
 
-    cfg = preset("scaled")
-    cfg.data.rows = rows
-    cfg.data.n_timesteps = 24 * 7 * 2 + 2 * batch
+    # base preset first, shared settings once after — so every plan
+    # (hetero included) measures the same dtype/shapes
+    if name == "hetero-region":
+        cfg = preset("multicity")
+        # second city one row smaller -> its N needs padding on region=2
+        cfg.data.override(
+            city_rows=(rows, rows - 1),
+            city_timesteps=(24 * 7 * 2 + 2 * batch, 24 * 7 * 2 + 2 * batch),
+        )
+        cfg.mesh.dp, cfg.mesh.region = 4, 2
+    else:
+        cfg = preset("scaled")
+        cfg.data.rows = rows
+        cfg.data.n_timesteps = 24 * 7 * 2 + 2 * batch
+        if name == "dp8":
+            cfg.mesh.dp, cfg.mesh.region = 8, 1
+            cfg.mesh.region_strategy = "gspmd"
+        elif name == "region8-gspmd":
+            cfg.mesh.region, cfg.mesh.region_strategy = 8, "gspmd"
+        elif name == "region8-auto":
+            cfg.mesh.region, cfg.mesh.region_strategy = 8, "auto"
+        elif name == "region8-sparse":
+            cfg.mesh.region, cfg.mesh.region_strategy = 8, "gspmd"
+            cfg.model.sparse = True
+        elif name == "branch3":
+            cfg.mesh.dp, cfg.mesh.region, cfg.mesh.branch = 1, 1, 3
+            cfg.mesh.region_strategy = "gspmd"
+        else:
+            raise ValueError(name)
     cfg.train.batch_size = batch
     cfg.train.out_dir = f"/tmp/comm_table_{name}"
     cfg.train.epochs = 1
-    # keep the measurement about sharding, not scan scheduling
+    # keep the measurement about sharding, not scan scheduling or dtype
     cfg.model.dtype = "bfloat16"
-    if name == "dp8":
-        cfg.mesh.dp, cfg.mesh.region = 8, 1
-        cfg.mesh.region_strategy = "gspmd"
-    elif name == "region8-gspmd":
-        cfg.mesh.region, cfg.mesh.region_strategy = 8, "gspmd"
-    elif name == "region8-auto":
-        cfg.mesh.region, cfg.mesh.region_strategy = 8, "auto"
-    elif name == "region8-sparse":
-        cfg.mesh.region, cfg.mesh.region_strategy = 8, "gspmd"
-        cfg.model.sparse = True
-    elif name == "branch3":
-        cfg.mesh.dp, cfg.mesh.region, cfg.mesh.branch = 1, 1, 3
-        cfg.mesh.region_strategy = "gspmd"
-    else:
-        raise ValueError(name)
     return cfg
 
 
@@ -68,12 +82,19 @@ def measure(name: str, rows: int, batch: int) -> dict:
 
     cfg = build_plan(name, rows, batch)
     tr = build_trainer(cfg, verbose=False)
-    batch_obj, (x, y, mask) = next(tr._placed_batches("train", with_arrays=True))
+    gen = tr._placed_batches("train", with_arrays=True)
+    batch_obj, (x, y, mask) = next(gen)
+    if name == "hetero-region":
+        # report the PADDED city's compiled step — the one whose plan the
+        # per-city padding machinery shapes
+        for batch_obj, (x, y, mask) in gen:
+            if tr._pad_for(batch_obj.city):
+                break
     # the full train step always carries HLO while loops (scanned LSTM,
     # sparse/halo paths) — accept lower-bound counts; while_count marks
     # every row so readers know the numbers don't multiply through loops
     stats = step_comm_report(
-        tr.step_fns.train_step,
+        tr._fns(batch_obj.city).train_step,
         tr.params,
         tr.opt_state,
         tr._supports_for(batch_obj),
@@ -102,7 +123,14 @@ def measure(name: str, rows: int, batch: int) -> dict:
     }
 
 
-PLANS = ("dp8", "region8-gspmd", "region8-auto", "region8-sparse", "branch3")
+PLANS = (
+    "dp8",
+    "region8-gspmd",
+    "region8-auto",
+    "region8-sparse",
+    "branch3",
+    "hetero-region",
+)
 
 
 def main() -> None:

@@ -2,6 +2,7 @@
 
 from stmgcn_tpu.utils.comm import collective_stats, step_comm_report
 from stmgcn_tpu.utils.flops import device_peak_flops, mfu, stmgcn_step_flops
+from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
 from stmgcn_tpu.utils.platform import force_host_platform
 from stmgcn_tpu.utils.profiling import (
     StepTimer,
@@ -12,8 +13,10 @@ from stmgcn_tpu.utils.profiling import (
 )
 
 __all__ = [
+    "BenchLock",
     "StepTimer",
     "collective_stats",
+    "host_load_snapshot",
     "device_peak_flops",
     "fence",
     "force_host_platform",

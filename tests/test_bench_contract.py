@@ -27,6 +27,10 @@ TINY = {
     "STMGCN_BENCH_BATCH": "8",
     "STMGCN_BENCH_WARMUP": "1",
     "STMGCN_BENCH_ITERS": "2",
+    # a private lock path: the contract tests must not block behind (or
+    # fail because of) a live tunnel-recovery loop holding the real
+    # host-wide lock for minutes at a time
+    "STMGCN_BENCH_LOCK_PATH": "/tmp/stmgcn_bench_test.lock",
 }
 
 #: ambient STMGCN_* (sweep leftovers, tuning exports) must not leak into
@@ -46,6 +50,12 @@ def test_canonical_record_shape():
     # both XLA schedules measured even at the tiny point
     assert set(rec["variants"]) == {"float32/plain", "float32/tuned"}
     assert rec["baseline"]["value"] is not None  # anchor provenance embedded
+    # host-load provenance: a contended record must be flaggable in-band
+    load = rec["host_load"]
+    assert load["lock"]["acquired"] is True
+    for snap in (load["before"], load["after"]):
+        assert snap["nproc"] >= 1
+        assert isinstance(snap["competing_python"], list)
 
 
 def test_scaled_mode_record():

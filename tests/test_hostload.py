@@ -1,0 +1,50 @@
+"""Host-load provenance + bench lock (stmgcn_tpu/utils/hostload.py).
+
+The lock is what keeps the measurement machinery from depressing its own
+records on this 1-core host (BASELINE.md round 4: concurrent probe
+children cost the driver's record 4-20%); the snapshot is what makes a
+contended record detectable in-band. Both are pure host-side code — fast
+tier."""
+
+import os
+
+from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
+
+
+def test_snapshot_shape():
+    snap = host_load_snapshot()
+    assert snap["nproc"] >= 1
+    assert snap["loadavg_1m"] is None or snap["loadavg_1m"] >= 0.0
+    for proc in snap["competing_python"]:
+        assert proc["pid"] != os.getpid()
+        assert "python" in proc["cmd"]
+
+
+def test_snapshot_excludes_self_and_ancestors():
+    pids = {p["pid"] for p in host_load_snapshot()["competing_python"]}
+    assert os.getpid() not in pids
+    assert os.getppid() not in pids
+
+
+def test_lock_excludes_second_holder(tmp_path):
+    path = str(tmp_path / "bench.lock")
+    first, second = BenchLock(path), BenchLock(path)
+    assert first.acquire(wait_s=1) is True
+    # flock is per open-file-description: a second open of the same path
+    # contends even within one process — exactly the cross-process case
+    assert second.acquire(wait_s=0.2, poll_s=0.05) is False
+    rec = second.record()
+    assert rec["acquired"] is False and rec["holder_pid"] == os.getpid()
+    first.release()
+    assert second.acquire(wait_s=1, poll_s=0.05) is True
+    assert second.record() == {"acquired": True, "waited_s": second.waited_s}
+    second.release()
+
+
+def test_lock_released_on_context_exit(tmp_path):
+    path = str(tmp_path / "bench.lock")
+    with BenchLock(path) as held:
+        assert held.acquired
+    again = BenchLock(path)
+    assert again.acquire(wait_s=0.5, poll_s=0.05) is True
+    again.release()

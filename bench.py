@@ -372,10 +372,17 @@ def _scaled_main(probe_err, native_tpu, lock, load_before) -> None:
     elif measure_err is not None:
         record["error"] = measure_err
     path = os.path.join(BENCH_DIR, "tpu_scaled_last_good.json")
-    if native_tpu and len(results) == 2 and measure_err is None and CANONICAL_POINT:
+    if (
+        native_tpu
+        and len(results) == 2
+        and measure_err is None
+        and CANONICAL_POINT
+        and lock.acquired
+    ):
         # same rule as the canonical snapshot: a clean on-chip table AT THE
-        # SHIPPED OPERATING POINT (no STMGCN_BENCH_* shape/iter overrides)
-        # becomes evidence; anything else must not overwrite it
+        # SHIPPED OPERATING POINT (no STMGCN_BENCH_* shape/iter overrides),
+        # measured while HOLDING the bench lock (a known-contended run must
+        # not overwrite good evidence), becomes evidence
         snapshot = dict(record)
         snapshot["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         snapshot["measurement"] = {"warmup": warmup, "iters": iters}
@@ -563,11 +570,18 @@ def main() -> None:
     # the round's TPU numbers; any non-TPU record carries the last good
     # on-chip table inline (with its own timestamp + device provenance).
     last_good_path = os.path.join(BENCH_DIR, "tpu_last_good.json")
-    if native_tpu and results and measure_err is None and CANONICAL_POINT:
-        # only a fully-clean on-chip run AT THE CANONICAL OPERATING POINT
-        # becomes canonical evidence — a run with failed legs, or one with
-        # STMGCN_BENCH_* shape/schedule overrides, must not overwrite the
-        # last good one (later cpu-fallback records inline this file)
+    if (
+        native_tpu
+        and results
+        and measure_err is None
+        and CANONICAL_POINT
+        and lock.acquired
+    ):
+        # only a fully-clean on-chip run AT THE CANONICAL OPERATING POINT,
+        # measured while HOLDING the bench lock, becomes canonical evidence
+        # — a run with failed legs, STMGCN_BENCH_* shape/schedule overrides,
+        # or known host contention must not overwrite the last good one
+        # (later cpu-fallback records inline this file)
         snapshot = dict(record)
         snapshot["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         snapshot["operating_point"] = {

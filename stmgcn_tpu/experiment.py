@@ -268,6 +268,7 @@ def build_model(
     support_modes=None,
     shard_spec=None,
     n_real_nodes=None,
+    lstm_pallas_mesh=None,
 ) -> STMGCN:
     """Model from config + the one data-derived scalar (feature count).
 
@@ -303,6 +304,7 @@ def build_model(
         lstm_unroll=m.lstm_unroll,
         lstm_fused_scan=m.lstm_fused_scan,
         lstm_backend=m.lstm_backend,
+        lstm_pallas_mesh=lstm_pallas_mesh,
         dtype=m.compute_dtype if m.dtype != "float32" else None,
     )
 
@@ -354,12 +356,24 @@ def build_trainer(
         n_pad = node_pad_target(cfg, dataset.n_nodes)
         node_pad_arg = (n_pad - dataset.n_nodes) if n_pad is not None else 0
         padded_city_nodes = [n_pad if n_pad is not None else dataset.n_nodes]
+    lstm_pallas_mesh = None
+    if cfg.model.lstm_backend == "pallas" and hasattr(placement, "mesh"):
+        if cfg.mesh.branch > 1:
+            # the per-shard launch shards rows over (dp, region); under a
+            # branch axis the LSTM runs inside GSPMD-sharded vmapped
+            # branches, a manual/auto mix sharded_fused_lstm doesn't do
+            raise ValueError(
+                "lstm_backend='pallas' does not compose with mesh.branch > 1 "
+                "— use the xla backend for branch-parallel meshes"
+            )
+        lstm_pallas_mesh = placement.mesh
     model = build_model(
         cfg,
         dataset.n_feats,
         support_modes,
         shard_spec,
         n_real_nodes=dataset.n_nodes if not hetero and n_pad is not None else None,
+        lstm_pallas_mesh=lstm_pallas_mesh,
     )
     if placement is not None and hasattr(placement, "check_divisibility"):
         for n_nodes in padded_city_nodes:

@@ -136,8 +136,12 @@ def export_forecaster(fc, path: str, *, platforms=("cpu", "tpu"), city=None) -> 
         params = to_vmapped_params(params, m)
     if model.lstm_backend != "xla":
         # Pallas lowers to a TPU-only custom call; the scan path is the
-        # same function of the same params (tests/test_pallas_lstm.py)
-        model = dataclasses.replace(model, lstm_backend="xla")
+        # same function of the same params (tests/test_pallas_lstm.py).
+        # A per-shard launch mesh is likewise a training-time device
+        # binding, meaningless in the exported single-device artifact.
+        model = dataclasses.replace(
+            model, lstm_backend="xla", lstm_pallas_mesh=None
+        )
 
     n_nodes = fc.derived["n_nodes"]
     normalizer = fc.normalizer

@@ -111,6 +111,8 @@ class CGLSTM(nn.Module):
     lstm_unroll: int = 1
     lstm_fused_scan: bool = False
     lstm_backend: str = "xla"
+    #: Mesh for per-shard pallas kernel launch (ops/lstm.py:StackedLSTM)
+    lstm_pallas_mesh: Any = None
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -140,6 +142,7 @@ class CGLSTM(nn.Module):
             unroll=self.lstm_unroll,
             fused_scan=self.lstm_fused_scan,
             backend=self.lstm_backend,
+            pallas_mesh=self.lstm_pallas_mesh,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="lstm",

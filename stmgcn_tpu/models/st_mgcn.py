@@ -47,6 +47,7 @@ class Branch(nn.Module):
     lstm_unroll: int = 1
     lstm_fused_scan: bool = False
     lstm_backend: str = "xla"
+    lstm_pallas_mesh: Any = None
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -67,6 +68,7 @@ class Branch(nn.Module):
             lstm_unroll=self.lstm_unroll,
             lstm_fused_scan=self.lstm_fused_scan,
             lstm_backend=self.lstm_backend,
+            lstm_pallas_mesh=self.lstm_pallas_mesh,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="cg_lstm",
@@ -129,6 +131,10 @@ class STMGCN(nn.Module):
     lstm_fused_scan: bool = False
     #: "xla" (scan) or "pallas" (hand-written fused kernel, ops/pallas_lstm.py)
     lstm_backend: str = "xla"
+    #: with lstm_backend="pallas" on a >1-device mesh: launch the kernel
+    #: per-shard over this Mesh (ops/pallas_lstm.py:sharded_fused_lstm)
+    #: instead of asking GSPMD to partition the Mosaic custom call
+    lstm_pallas_mesh: Any = None
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -162,6 +168,7 @@ class STMGCN(nn.Module):
             lstm_unroll=self.lstm_unroll,
             lstm_fused_scan=self.lstm_fused_scan,
             lstm_backend=self.lstm_backend,
+            lstm_pallas_mesh=self.lstm_pallas_mesh,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )

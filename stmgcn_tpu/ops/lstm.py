@@ -88,6 +88,13 @@ class StackedLSTM(nn.Module):
     #: parameters, same math (equality-tested); explicit initial states
     #: fall back to the scan path.
     backend: str = "xla"
+    #: with ``backend="pallas"`` on a >1-device mesh: the Mesh to launch
+    #: per-shard kernels over (rows sharded on ``pallas_row_axes``, weight
+    #: grads psummed — ops/pallas_lstm.py:sharded_fused_lstm). ``None``
+    #: launches one global kernel and lets GSPMD place it (single-device
+    #: semantics).
+    pallas_mesh: Any = None
+    pallas_row_axes: tuple = ("dp", "region")
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -180,8 +187,13 @@ class StackedLSTM(nn.Module):
 
     def _pallas(self, x: jnp.ndarray):
         """Hand-written fused kernel path (zero initial state only)."""
-        from stmgcn_tpu.ops.pallas_lstm import fused_lstm
+        from stmgcn_tpu.ops.pallas_lstm import fused_lstm, sharded_fused_lstm
 
+        kernel = (
+            sharded_fused_lstm(self.pallas_mesh, tuple(self.pallas_row_axes))
+            if self.pallas_mesh is not None
+            else fused_lstm
+        )
         L, h_dim = self.num_layers, self.hidden_dim
         x, params = self._collect_params(x)
         wx0, _, b0 = params[0]
@@ -193,7 +205,7 @@ class StackedLSTM(nn.Module):
         else:  # never-read placeholder: the kernel operand can't be empty
             wx_stack = jnp.zeros((1, h_dim, 4 * h_dim), x_proj0.dtype)
             b_stack = jnp.zeros((1, 4 * h_dim), x_proj0.dtype)
-        hs_top, h_fin, c_fin = fused_lstm(x_proj0, wh_stack, wx_stack, b_stack)
+        hs_top, h_fin, c_fin = kernel(x_proj0, wh_stack, wx_stack, b_stack)
         return hs_top, [(h_fin[layer], c_fin[layer]) for layer in range(L)]
 
     def _fused(self, x: jnp.ndarray, initial_states: Optional[list]):

@@ -36,13 +36,17 @@ Zero initial state per call is the reference's semantics
 (``STMGCN.py:53-57``); callers that pass explicit initial states use the
 scan path instead.
 
-Mesh caveat: on a multi-chip mesh the kernel is a Mosaic custom call,
-and GSPMD's partitioning of custom calls is not validated here (this
-image exposes one real chip; the 8-virtual-device tests exercise the
-*interpret* lowering, which partitions as ordinary HLO). Multi-chip
-runs default to ``backend="xla"`` — the scan path shards on every mesh
-axis with tested loss parity — and should treat ``pallas`` on a mesh
-as experimental until measured on real multi-chip hardware. Numerics: elementwise cell arithmetic (gates,
+Mesh composition: :func:`sharded_fused_lstm` wraps the kernel pair in
+``shard_map`` over the row axis — each device launches the kernel on
+its local rows (rows are embarrassingly parallel; the per-shard grid is
+the same grid-over-row-blocks, just shorter) and the backward psums
+the weight gradients across shards explicitly, so GSPMD never has to
+partition the Mosaic custom call itself. Validated for values + grads
+against the unsharded kernel on the 8-virtual-device CPU mesh
+(interpret lowering) by ``tests/test_pallas_lstm.py``; not yet *timed*
+on real multi-chip hardware (this image exposes one chip), so the
+multi-chip default remains ``backend="xla"`` and ``pallas`` on a mesh
+is opt-in via ``StackedLSTM.pallas_mesh``. Numerics: elementwise cell arithmetic (gates,
 tanh/sigmoid, state updates) is float32 regardless of storage dtype, but
 matmul *operands* are kept in the storage dtype with f32 accumulation
 (``_mm``) — for bf16 storage that means f32-resident states and
@@ -61,7 +65,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["fused_lstm", "pallas_lstm_available"]
+__all__ = ["fused_lstm", "pallas_lstm_available", "sharded_fused_lstm"]
 
 #: rows per grid step — sized so each kernel's blocks plus
 #: double-buffering and straight-line temporaries stay inside the
@@ -434,3 +438,78 @@ def _fused_bwd(residuals, cotangents):
 
 
 fused_lstm.defvjp(_fused_fwd, _fused_bwd)
+
+
+@functools.lru_cache(maxsize=8)
+def sharded_fused_lstm(mesh, row_axes: tuple = ("dp", "region")):
+    """A :func:`fused_lstm` variant launching per-shard kernels over ``mesh``.
+
+    Rows (axis 0 of ``x_proj0``; ``B*N`` in the model, where batch shards
+    over ``dp`` and nodes over ``region``) shard over ``row_axes``;
+    weights are replicated. Each device runs the unmodified kernel pair
+    on its local row block — rows are embarrassingly parallel through
+    the whole ``T x L`` recurrence, so the only cross-device
+    communication in the op is the backward's explicit weight-gradient
+    ``psum`` (the same all-reduce the dp-sharded scan path's grads pay
+    under GSPMD). Wrapping in ``shard_map`` means GSPMD never needs a
+    partitioning rule for the Mosaic custom call — the round-3 caveat
+    this function retires.
+
+    ``row_axes`` entries absent from the mesh are ignored, so the
+    default works on ``(dp,)``-only and ``(dp, region)`` meshes alike.
+    The kernel's row-padding happens per shard (each shard pads its
+    local rows up to the block size), which is exactly the padding a
+    single-device run of the same local shape would do.
+
+    Cached per ``(mesh, row_axes)`` so flax re-traces reuse one
+    ``custom_vjp`` instance instead of registering a fresh pair per call.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(a for a in row_axes if a in mesh.shape)
+    if not axes:
+        return fused_lstm
+    row = P(axes, None, None)  # x_proj0 (R, T, 4H) / hs_top (R, T, H) / dxp
+    fin = P(None, axes, None)  # h_fin / c_fin (L, R, H) + their cotangents
+    seq = P(None, None, axes, None)  # hseq / cseq residuals (T, L, Rp, H)
+    rep = P()
+    result_specs = (row, fin, fin)
+    resid_specs = (row, rep, rep, rep, seq, seq)
+
+    # check_vma=False on both maps: the pallas_call's out_shape carries no
+    # varying-mesh-axes metadata (same reason as parallel/sparse.py)
+    fwd_m = shard_map(
+        _fused_fwd,
+        mesh=mesh,
+        in_specs=(row, rep, rep, rep),
+        out_specs=(result_specs, resid_specs),
+        check_vma=False,
+    )
+
+    def _bwd_local(residuals, cotangents):
+        dxp, dwh, dwx, db = _fused_bwd(residuals, cotangents)
+        # replicated weights: their true cotangent is the sum of every
+        # shard's local contribution (the transpose of a broadcast)
+        dwh = jax.lax.psum(dwh, axes)
+        dwx = jax.lax.psum(dwx, axes)
+        db = jax.lax.psum(db, axes)
+        return dxp, dwh, dwx, db
+
+    bwd_m = shard_map(
+        _bwd_local,
+        mesh=mesh,
+        in_specs=(resid_specs, result_specs),
+        out_specs=(row, rep, rep, rep),
+        check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def sharded(x_proj0, wh_stack, wx_stack, b_stack):
+        return fwd_m(x_proj0, wh_stack, wx_stack, b_stack)[0]
+
+    sharded.defvjp(
+        lambda *operands: fwd_m(*operands),
+        lambda residuals, cotangents: bwd_m(residuals, cotangents),
+    )
+    return sharded

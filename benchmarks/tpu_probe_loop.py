@@ -127,6 +127,16 @@ def runbook() -> None:
             3600,
             True,
         ),
+        # the scaled accuracy leg takes the bench lock itself (it IS a
+        # measurement process like bench.py) — spawning it under the
+        # parent's hold would deadlock
+        (
+            "scaled-accuracy",
+            [py, "benchmarks/scaled_accuracy.py"],
+            {},
+            7200,
+            False,
+        ),
     ]
     for name, argv, env_extra, timeout_s, take_lock in legs:
         run_leg(name, argv, env_extra, timeout_s, take_lock)

@@ -185,12 +185,13 @@ def _strategy_active(cfg: ExperimentConfig) -> bool:
         raise ValueError(
             f"mesh.region_strategy must be gspmd|banded|auto, got {s!r}"
         )
-    if cfg.mesh.branch > 1 and (cfg.model.sparse or (s != "gspmd" and cfg.mesh.region > 1)):
-        # branch parallelism shards the *vmapped stacked* branch axis; the
-        # loop layouts (sparse / explicit region plans) have no such axis
+    if cfg.mesh.branch > 1 and cfg.model.sparse:
+        # the sparse loop layout has no stacked branch axis to shard, and
+        # the Pallas SpMM is not vmappable over the graph axis; banded
+        # branch meshes compose (branch-stacked strips, route_supports)
         raise ValueError(
-            "mesh.branch > 1 requires dense vmapped branches — it cannot "
-            "combine with model.sparse or an active region_strategy"
+            "mesh.branch > 1 cannot combine with model.sparse — use dense "
+            "or banded supports for branch-parallel meshes"
         )
     return s != "gspmd" and cfg.mesh.region > 1 and not cfg.model.sparse
 
@@ -210,6 +211,12 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
     - sparse on a >1-device mesh: ``("sparse",) * M`` with each branch's
       supports as :class:`~stmgcn_tpu.parallel.sparse.ShardedBlockSparse`
       row strips over the region axis.
+    - active strategy + ``mesh.branch > 1``: a single branch-stacked
+      :class:`~stmgcn_tpu.parallel.banded.BandedSupports` (all branches'
+      strips at one common halo) with ``("banded",) * M`` — the vmapped
+      branch axis shards it; if any branch exceeds the budget, ``auto``
+      falls back to all-dense GSPMD (``modes=None``) and ``banded``
+      raises.
     """
     _strategy_active(cfg)  # validates strategy / branch-axis combinations
     if not dataset.shared_graphs and (
@@ -243,9 +250,39 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
         raise ValueError(f"n_nodes {n} not divisible by region={region}")
     n_local = n // region
     budget = min(cfg.mesh.halo if cfg.mesh.halo is not None else n_local // 2, n_local)
+    bws = [
+        max(bandwidth(supports[m, k]) for k in range(supports.shape[1]))
+        for m in range(supports.shape[0])
+    ]
+    if cfg.mesh.branch > 1:
+        # branch parallelism needs ONE stacked operand the vmapped branch
+        # axis can shard — every branch must fit the banded plan at a
+        # common halo (mixed banded/dense routing has no stacked form)
+        from stmgcn_tpu.parallel.banded import branch_stack
+
+        over = [m for m, bw in enumerate(bws) if bw > budget]
+        if over and cfg.mesh.region_strategy == "banded":
+            raise ValueError(
+                "mesh.branch > 1 with region_strategy='banded' needs every "
+                f"branch banded, but branches {over} have support bandwidth "
+                f"> halo budget {budget} (shard size {n_local}) — use "
+                "'auto' (falls back to GSPMD), raise mesh.halo, or reorder "
+                "nodes to reduce bandwidth"
+            )
+        if over:
+            # 'auto' keeps its contract: when the halo plan can't cover
+            # every branch, the whole (still fully supported) dense
+            # branch-parallel plan stays on GSPMD
+            return supports, None
+        stacked = branch_stack(
+            [np.asarray(supports[m]) for m in range(supports.shape[0])],
+            region,
+            halo=max(bws),
+        )
+        return stacked, ("banded",) * supports.shape[0]
     routed, modes = [], []
     for m in range(supports.shape[0]):
-        bw = max(bandwidth(supports[m, k]) for k in range(supports.shape[1]))
+        bw = bws[m]
         if bw <= budget:
             routed.append(banded_decompose(np.asarray(supports[m]), region, halo=bw))
             modes.append("banded")
@@ -275,8 +312,10 @@ def build_model(
     ``support_modes``/``shard_spec`` come from :func:`route_supports` +
     the live mesh. Whenever the config's region strategy is active the
     branch parameters use the loop layout (``branch_0..branch_{M-1}``)
-    regardless of how many branches actually routed banded, so the
-    checkpoint layout is a function of the config alone — a
+    regardless of how many branches actually routed banded — EXCEPT
+    ``mesh.branch > 1``, whose branch-stacked banded supports keep the
+    vmapped stacked layout (the mesh shards its branch axis). Either
+    way the checkpoint layout is a function of the config alone — a
     single-device rebuild (e.g. :class:`~stmgcn_tpu.inference.Forecaster`)
     reconstructs the same layout with plain dense supports. (Sparse mode
     always uses the loop layout, sharded or not.)
@@ -299,7 +338,11 @@ def build_model(
         support_modes=support_modes,
         shard_spec=shard_spec,
         n_real_nodes=n_real_nodes,
-        vmap_branches=not _strategy_active(cfg),
+        # active region strategies use the per-branch loop layout — except
+        # branch-parallel meshes, whose branch-stacked banded supports
+        # shard the vmapped branch axis (route_supports guarantees the
+        # uniform stacked form whenever mesh.branch > 1)
+        vmap_branches=not _strategy_active(cfg) or cfg.mesh.branch > 1,
         remat=m.remat,
         lstm_unroll=m.lstm_unroll,
         lstm_fused_scan=m.lstm_fused_scan,

@@ -114,7 +114,9 @@ class STMGCN(nn.Module):
     #: "dense")`` — branches with banded (grid-structured) supports take
     #: the explicit halo-exchange plan while the rest stay on GSPMD.
     #: ``None`` derives a uniform tuple from ``sparse``. Any non-dense
-    #: entry forces the loop path (params under branch_0..branch_{M-1}).
+    #: entry forces the loop path (params under branch_0..branch_{M-1}),
+    #: EXCEPT all-banded with branch-stacked strips + vmap_branches=True:
+    #: that runs ONE vmapped Branch whose branch axis a mesh can shard.
     support_modes: Optional[tuple] = None
     #: static mesh/axis routing for "banded" branches and mesh-sharded
     #: "sparse" branches
@@ -181,7 +183,25 @@ class STMGCN(nn.Module):
         ``BlockSparse``, or ``BandedSupports``; ``obs_seq`` ``(B, T, N, C)``."""
         modes = self.branch_modes()
         all_dense = all(m == "dense" for m in modes)
-        if not all_dense:
+        from stmgcn_tpu.parallel.banded import BandedSupports
+
+        banded_stacked = (
+            self.vmap_branches
+            and isinstance(supports_stack, BandedSupports)
+            and supports_stack.branch_stacked
+        )
+        if banded_stacked:
+            if not all(m == "banded" for m in modes):
+                raise ValueError(
+                    "branch-stacked BandedSupports need support_modes "
+                    f"('banded',) * {self.m_graphs}, got {modes}"
+                )
+            if supports_stack.strips.shape[0] != self.m_graphs:
+                raise ValueError(
+                    f"branch-stacked strips carry {supports_stack.strips.shape[0]} "
+                    f"branches, model has {self.m_graphs}"
+                )
+        elif not all_dense:
             if len(supports_stack) != self.m_graphs:
                 raise ValueError(
                     f"need {self.m_graphs} per-branch support groups, "
@@ -194,7 +214,33 @@ class STMGCN(nn.Module):
                     f"supports_stack must be ({self.m_graphs}, K, N, N), "
                     f"got {supports_stack.shape}"
                 )  # STMGCN.py:107
-        if not all_dense or not self.vmap_branches:
+        if banded_stacked:
+            # branch-parallel banded: ONE vmapped Branch over the stacked
+            # strips. spmd_axis_name tells the inner halo-exchange
+            # shard_maps that the vmapped axis is the mesh's branch axis,
+            # so each branch group runs its own ring exchange over region
+            # while the branch dim shards away (no batching rule needed).
+            # Only at apply time: flax's rng-split machinery during init
+            # rejects spmd_axis_name's axis tree, and the created params
+            # are identical either way (placement shards them afterwards).
+            spmd = (
+                "branch"
+                if not self.is_initializing()
+                and self.shard_spec is not None
+                and self.shard_spec.mesh.shape.get("branch", 1) > 1
+                else None
+            )
+            branches = nn.vmap(
+                Branch,
+                in_axes=(0, None),
+                out_axes=0,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                spmd_axis_name=spmd,
+            )(**self._branch_kwargs("banded"), name="branches")
+            feats = branches(supports_stack, obs_seq)  # (M, B, N, gcn_hidden)
+            fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
+        elif not all_dense or not self.vmap_branches:
             feats = [
                 Branch(**self._branch_kwargs(modes[m]), name=f"branch_{m}")(
                     supports_stack[m], obs_seq

@@ -26,6 +26,7 @@ from stmgcn_tpu.parallel.banded import (
     ShardSpec,
     banded_decompose,
     bandwidth,
+    branch_stack,
     sharded_banded_apply,
     strip_decompose,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "ShardSpec",
     "ShardedBlockSparse",
     "banded_decompose",
+    "branch_stack",
     "bandwidth",
     "build_mesh",
     "halo_exchange",

@@ -37,6 +37,7 @@ __all__ = [
     "BandedSupports",
     "bandwidth",
     "banded_decompose",
+    "branch_stack",
     "sharded_banded_apply",
     "strip_decompose",
 ]
@@ -60,10 +61,17 @@ BandedSpec = ShardSpec
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class BandedSupports:
-    """One branch's supports in strip form: the banded analogue of the
-    dense ``(K, N, N)`` stack. ``strips`` is :func:`strip_decompose`
-    output ``(n_shards, K, n_local, n_local + 2*halo)``; ``halo`` and the
-    global node count ``n`` are static metadata."""
+    """Supports in strip form: the banded analogue of the dense
+    ``(K, N, N)`` stack. ``strips`` is :func:`strip_decompose` output
+    ``(n_shards, K, n_local, n_local + 2*halo)``; ``halo`` and the
+    global node count ``n`` are static metadata.
+
+    The branch-stacked form used by branch-parallel meshes
+    (:func:`branch_stack`) carries a leading graph axis:
+    ``(M, n_shards, K, n_local, n_local + 2*halo)`` with ONE common halo
+    — ``nn.vmap`` over the model's branch axis then maps ``strips``'s
+    axis 0, handing each branch the ordinary 4-d form. Shape properties
+    index from the end so both forms answer correctly."""
 
     strips: jnp.ndarray
     halo: int
@@ -80,11 +88,38 @@ class BandedSupports:
 
     @property
     def n_supports(self) -> int:
-        return self.strips.shape[1]
+        return self.strips.shape[-3]
 
     @property
     def n_shards(self) -> int:
-        return self.strips.shape[0]
+        return self.strips.shape[-4]
+
+    @property
+    def branch_stacked(self) -> bool:
+        return self.strips.ndim == 5
+
+
+def branch_stack(
+    per_branch_supports, n_shards: int, halo: int | None = None
+) -> BandedSupports:
+    """Stack M branches' ``(K, N, N)`` dense supports into ONE
+    branch-stacked :class:`BandedSupports` at their common (max) halo.
+
+    Branch model parallelism shards the model's vmapped branch axis over
+    the mesh; the supports must then be a single stacked operand rather
+    than a per-branch Python tuple. A common halo costs the
+    narrower-band branches a few extra exchanged rows but buys one
+    uniform strip shape — the same trade the per-city node padding makes
+    for heterogeneous meshes. Pass ``halo`` when the caller already
+    scanned the bandwidths (``strip_decompose`` still validates it);
+    ``None`` computes the max here."""
+    mats = [np.asarray(s, dtype=np.float32) for s in per_branch_supports]
+    if halo is None:
+        halo = max(
+            max(bandwidth(m[k]) for k in range(m.shape[0])) for m in mats
+        )
+    stacked = np.stack([strip_decompose(m, n_shards, halo) for m in mats])
+    return BandedSupports(strips=jnp.asarray(stacked), halo=halo, n=mats[0].shape[1])
 
 
 def banded_decompose(supports, n_shards: int, halo: int | None = None) -> BandedSupports:

@@ -112,9 +112,15 @@ class MeshPlacement:
         if isinstance(supports, (tuple, list)):
             return tuple(self._put_supports(s) for s in supports)
         if isinstance(supports, BandedSupports):
+            # branch-stacked strips (M, shards, K, nl, nl+2h) shard the
+            # graph axis over 'branch' too; plain strips lead with shards
+            spec = (
+                P("branch", "region", None, None, None)
+                if supports.branch_stacked and "branch" in self.mesh.shape
+                else P(*([None] * (supports.strips.ndim - 4)), "region", None, None, None)
+            )
             strips = jax.device_put(
-                jnp.asarray(supports.strips),
-                NamedSharding(self.mesh, P("region", None, None, None)),
+                jnp.asarray(supports.strips), NamedSharding(self.mesh, spec)
             )
             return BandedSupports(strips=strips, halo=supports.halo, n=supports.n)
         if isinstance(supports, ShardedBlockSparse):

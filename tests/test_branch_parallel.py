@@ -99,17 +99,30 @@ class TestBranchParallelParity:
 
 
 class TestBranchGuards:
-    def test_branch_rejects_sparse_and_region_strategy(self):
+    def test_branch_rejects_sparse_but_composes_with_banded(self):
         cfg = preset("smoke")
         cfg.data.n_timesteps = 24 * 7 * 2 + 48
         cfg.mesh.dp, cfg.mesh.branch = 1, 1  # keep n_devices small for build
         cfg.mesh.branch = 2
         cfg.model.sparse = True
         ds = build_dataset(cfg)
-        with pytest.raises(ValueError, match="branch"):
+        with pytest.raises(ValueError, match="sparse"):
             route_supports(cfg, ds)
+        # an active region strategy no longer rejects wholesale (round 5:
+        # branch-stacked banded strips, tests/test_branch_banded.py).
+        # Budget pinned below the grid bandwidth: 'banded' demands every
+        # branch qualify and raises; 'auto' keeps its contract and falls
+        # back to the fully-supported all-dense GSPMD branch plan
         cfg.model.sparse = False
         cfg.mesh.region = 2
-        cfg.mesh.region_strategy = "auto"
-        with pytest.raises(ValueError, match="branch"):
+        cfg.mesh.halo = 1
+        cfg.mesh.region_strategy = "banded"
+        with pytest.raises(ValueError, match="every branch banded"):
             route_supports(cfg, ds)
+        cfg.mesh.region_strategy = "auto"
+        _, modes = route_supports(cfg, ds)
+        assert modes is None  # GSPMD fallback, not an error
+        # with an adequate budget the same config routes branch-stacked
+        cfg.mesh.halo = None
+        sup, modes = route_supports(cfg, ds)
+        assert set(modes) == {"banded"} and sup.branch_stacked

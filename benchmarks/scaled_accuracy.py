@@ -38,7 +38,6 @@ def main() -> None:
 
     # probe in a killable child (the in-process backend init can hang on a
     # wedged tunnel) — same discipline as bench.py
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import subprocess
 
     from stmgcn_tpu.utils.hostload import PROBE_SRC

@@ -191,8 +191,10 @@ class MeshConfig:
     region: int = 1
     #: graph-branch model parallelism: shard the M stacked branches (and
     #: their params/supports) over this axis; the sum fusion becomes one
-    #: psum. Requires dense vmapped branches (no sparse / region_strategy)
-    #: and m_graphs % branch == 0.
+    #: psum. Requires m_graphs % branch == 0. Composes with dense GSPMD,
+    #: branch-stacked banded strips (every branch within the halo budget;
+    #: 'auto' falls back to dense GSPMD otherwise), and branch-stacked
+    #: block-CSR sparse supports.
     branch: int = 1
     #: how region-sharded graph convs communicate:
     #: - "gspmd": dense supports, XLA's automatic plan (all-gathers the

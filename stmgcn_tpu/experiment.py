@@ -185,14 +185,9 @@ def _strategy_active(cfg: ExperimentConfig) -> bool:
         raise ValueError(
             f"mesh.region_strategy must be gspmd|banded|auto, got {s!r}"
         )
-    if cfg.mesh.branch > 1 and cfg.model.sparse:
-        # the sparse loop layout has no stacked branch axis to shard, and
-        # the Pallas SpMM is not vmappable over the graph axis; banded
-        # branch meshes compose (branch-stacked strips, route_supports)
-        raise ValueError(
-            "mesh.branch > 1 cannot combine with model.sparse — use dense "
-            "or banded supports for branch-parallel meshes"
-        )
+    # (round 5: mesh.branch > 1 composes with BOTH loop-layout support
+    # families now — banded via branch-stacked strips, sparse via
+    # branch-stacked block-CSR; route_supports builds the stacked forms)
     return s != "gspmd" and cfg.mesh.region > 1 and not cfg.model.sparse
 
 
@@ -229,9 +224,18 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
             "mode for multi-city mesh configs"
         )
     if cfg.model.sparse and cfg.mesh.n_devices > 1:
-        from stmgcn_tpu.parallel.sparse import sharded_from_dense
+        from stmgcn_tpu.parallel.sparse import branch_stack_sparse, sharded_from_dense
 
         dense = _dense_supports(cfg, dataset.adjs)
+        if cfg.mesh.branch > 1:
+            # branch parallelism needs ONE stacked operand: all branches'
+            # strips at a common block-column width, vmapped branch axis
+            # sharded over the mesh (same shape trade as banded's common
+            # halo — see parallel.sparse.branch_stack_sparse)
+            return (
+                branch_stack_sparse(dense, cfg.mesh.region),
+                ("sparse",) * dense.shape[0],
+            )
         routed = tuple(
             sharded_from_dense(dense[m], cfg.mesh.region)
             for m in range(dense.shape[0])
@@ -318,7 +322,8 @@ def build_model(
     way the checkpoint layout is a function of the config alone — a
     single-device rebuild (e.g. :class:`~stmgcn_tpu.inference.Forecaster`)
     reconstructs the same layout with plain dense supports. (Sparse mode
-    always uses the loop layout, sharded or not.)
+    uses the loop layout — except under ``mesh.branch > 1``, which is
+    vmapped like everything branch-parallel.)
     """
     m = cfg.model
     return STMGCN(
@@ -333,8 +338,12 @@ def build_model(
         use_bias=m.use_bias,
         shared_gate_fc=m.shared_gate_fc,
         # support_modes carries the routing when set (e.g. sharded sparse);
-        # sparse=True alongside it would be rejected by the model
-        sparse=m.sparse and support_modes is None,
+        # sparse=True alongside it would be rejected by the model. A
+        # branch>1 sparse config trains in the vmapped stacked layout
+        # (branch-stacked block-CSR), so its mesh-less rebuild (Forecaster
+        # with dense supports) must use the vmapped dense path too — NOT
+        # the sparse loop layout — or the param trees would not match.
+        sparse=m.sparse and support_modes is None and cfg.mesh.branch == 1,
         support_modes=support_modes,
         shard_spec=shard_spec,
         n_real_nodes=n_real_nodes,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -115,8 +116,10 @@ class STMGCN(nn.Module):
     #: the explicit halo-exchange plan while the rest stay on GSPMD.
     #: ``None`` derives a uniform tuple from ``sparse``. Any non-dense
     #: entry forces the loop path (params under branch_0..branch_{M-1}),
-    #: EXCEPT all-banded with branch-stacked strips + vmap_branches=True:
-    #: that runs ONE vmapped Branch whose branch axis a mesh can shard.
+    #: EXCEPT a uniformly banded/sparse tuple whose supports arrive
+    #: branch-stacked (BandedSupports strips / ShardedBlockSparse with a
+    #: leading M axis) + vmap_branches=True: that runs ONE vmapped Branch
+    #: whose branch axis a mesh can shard.
     support_modes: Optional[tuple] = None
     #: static mesh/axis routing for "banded" branches and mesh-sharded
     #: "sparse" branches
@@ -184,22 +187,25 @@ class STMGCN(nn.Module):
         modes = self.branch_modes()
         all_dense = all(m == "dense" for m in modes)
         from stmgcn_tpu.parallel.banded import BandedSupports
+        from stmgcn_tpu.parallel.sparse import ShardedBlockSparse
 
-        banded_stacked = (
+        branch_stacked = (
             self.vmap_branches
-            and isinstance(supports_stack, BandedSupports)
+            and isinstance(supports_stack, (BandedSupports, ShardedBlockSparse))
             and supports_stack.branch_stacked
         )
-        if banded_stacked:
-            if not all(m == "banded" for m in modes):
+        if branch_stacked:
+            want = "banded" if isinstance(supports_stack, BandedSupports) else "sparse"
+            if modes != (want,) * self.m_graphs:
                 raise ValueError(
-                    "branch-stacked BandedSupports need support_modes "
-                    f"('banded',) * {self.m_graphs}, got {modes}"
+                    f"branch-stacked supports need support_modes "
+                    f"('{want}',) * {self.m_graphs}, got {modes}"
                 )
-            if supports_stack.strips.shape[0] != self.m_graphs:
+            leading = jax.tree_util.tree_leaves(supports_stack)[0].shape[0]
+            if leading != self.m_graphs:
                 raise ValueError(
-                    f"branch-stacked strips carry {supports_stack.strips.shape[0]} "
-                    f"branches, model has {self.m_graphs}"
+                    f"branch-stacked supports carry {leading} branches, "
+                    f"model has {self.m_graphs}"
                 )
         elif not all_dense:
             if len(supports_stack) != self.m_graphs:
@@ -214,15 +220,17 @@ class STMGCN(nn.Module):
                     f"supports_stack must be ({self.m_graphs}, K, N, N), "
                     f"got {supports_stack.shape}"
                 )  # STMGCN.py:107
-        if banded_stacked:
-            # branch-parallel banded: ONE vmapped Branch over the stacked
-            # strips. spmd_axis_name tells the inner halo-exchange
-            # shard_maps that the vmapped axis is the mesh's branch axis,
-            # so each branch group runs its own ring exchange over region
-            # while the branch dim shards away (no batching rule needed).
-            # Only at apply time: flax's rng-split machinery during init
-            # rejects spmd_axis_name's axis tree, and the created params
-            # are identical either way (placement shards them afterwards).
+        if branch_stacked:
+            # branch-parallel loop-layout supports (banded strips or
+            # block-CSR): ONE vmapped Branch over the stacked operand.
+            # spmd_axis_name tells the inner shard_maps (ring halo
+            # exchange / sharded SpMM) that the vmapped axis is the
+            # mesh's branch axis, so each branch group runs its own
+            # region collectives while the branch dim shards away (no
+            # kernel batching rule needed). Only at apply time: flax's
+            # rng-split machinery during init rejects spmd_axis_name's
+            # axis tree, and the created params are identical either way
+            # (placement shards them afterwards).
             spmd = (
                 "branch"
                 if not self.is_initializing()
@@ -237,7 +245,7 @@ class STMGCN(nn.Module):
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 spmd_axis_name=spmd,
-            )(**self._branch_kwargs("banded"), name="branches")
+            )(**self._branch_kwargs(modes[0]), name="branches")
             feats = branches(supports_stack, obs_seq)  # (M, B, N, gcn_hidden)
             fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
         elif not all_dense or not self.vmap_branches:

@@ -35,6 +35,7 @@ from stmgcn_tpu.parallel.mesh import build_mesh, init_distributed, mesh_from_con
 from stmgcn_tpu.parallel.placement import MeshPlacement
 from stmgcn_tpu.parallel.sparse import (
     ShardedBlockSparse,
+    branch_stack_sparse,
     sharded_from_dense,
     sharded_spmm_apply,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "ShardedBlockSparse",
     "banded_decompose",
     "branch_stack",
+    "branch_stack_sparse",
     "bandwidth",
     "build_mesh",
     "halo_exchange",

@@ -125,7 +125,13 @@ class MeshPlacement:
             return BandedSupports(strips=strips, halo=supports.halo, n=supports.n)
         if isinstance(supports, ShardedBlockSparse):
             def shard_leading(a):
-                spec = P("region", *([None] * (a.ndim - 1)))
+                if supports.branch_stacked:
+                    # (M, S, ...): graph axis leads; shard it over 'branch'
+                    # when the mesh has that axis, never over 'region'
+                    lead = ("branch",) if "branch" in self.mesh.shape else (None,)
+                    spec = P(*lead, "region", *([None] * (a.ndim - 2)))
+                else:  # (S, ...): shard axis leads
+                    spec = P("region", *([None] * (a.ndim - 1)))
                 return jax.device_put(jnp.asarray(a), NamedSharding(self.mesh, spec))
 
             return ShardedBlockSparse(

@@ -38,7 +38,12 @@ from stmgcn_tpu.ops.spmm import (
     spmm_stack,
 )
 
-__all__ = ["ShardedBlockSparse", "sharded_from_dense", "sharded_spmm_apply"]
+__all__ = [
+    "ShardedBlockSparse",
+    "branch_stack_sparse",
+    "sharded_from_dense",
+    "sharded_spmm_apply",
+]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -49,6 +54,13 @@ class ShardedBlockSparse:
 
     ``data`` ``(S, K, R_loc, C, tile, tile)``, ``idx`` ``(S, K, R_loc, C)``;
     transpose structure likewise (each strip's ``(N, n_local)`` transpose).
+
+    The branch-stacked form used by branch-parallel meshes
+    (:func:`branch_stack_sparse`) carries a leading graph axis — ``data``
+    ``(M, S, K, R_loc, C, tile, tile)`` with one common block-column
+    width: ``nn.vmap`` over the model's branch axis then maps axis 0,
+    handing each branch the ordinary form. Shape properties index from
+    the end so both forms answer correctly.
     """
 
     data: jnp.ndarray
@@ -69,11 +81,15 @@ class ShardedBlockSparse:
 
     @property
     def n_shards(self) -> int:
-        return self.data.shape[0]
+        return self.data.shape[-6]
 
     @property
     def n_supports(self) -> int:
-        return self.data.shape[1]
+        return self.data.shape[-5]
+
+    @property
+    def branch_stacked(self) -> bool:
+        return self.data.ndim == 7
 
     @property
     def n_local(self) -> int:
@@ -90,6 +106,21 @@ def sharded_from_dense(mats, n_shards: int, tile: int = TILE) -> ShardedBlockSpa
     All shards share one ``(c_max, c_max_t)`` so the stacked arrays are
     uniform (padding rows keep index 0 with zero data, harmless).
     """
+    data, idx, data_t, idx_t, n = _sharded_np(mats, n_shards, tile)
+    return ShardedBlockSparse(
+        data=jnp.asarray(data),
+        idx=jnp.asarray(idx),
+        data_t=jnp.asarray(data_t),
+        idx_t=jnp.asarray(idx_t),
+        n=n,
+        tile=tile,
+    )
+
+
+def _sharded_np(mats, n_shards: int, tile: int):
+    """Host-side assembly of :func:`sharded_from_dense`'s arrays (numpy) —
+    shared with :func:`branch_stack_sparse`, which must pad and re-stack
+    before anything is uploaded to a device."""
     mats = np.asarray(mats, dtype=np.float32)
     k, n, n2 = mats.shape
     if n != n2:
@@ -124,12 +155,45 @@ def sharded_from_dense(mats, n_shards: int, tile: int = TILE) -> ShardedBlockSpa
 
     data, idx = assemble(fwd_scan, c_max)
     data_t, idx_t = assemble(bwd_scan, c_max_t)
+    return data, idx, data_t, idx_t, n
+
+
+def branch_stack_sparse(
+    dense_stack, n_shards: int, tile: int = TILE
+) -> ShardedBlockSparse:
+    """Stack M branches' ``(K, N, N)`` dense supports into ONE
+    branch-stacked :class:`ShardedBlockSparse`.
+
+    Branch model parallelism shards the model's vmapped branch axis over
+    the mesh; the sparse supports must then be a single stacked operand.
+    Each branch keeps its own block-CSR content, but the block-column
+    axis pads to the *max* occupancy across branches so the stacked
+    arrays are uniform — padding blocks keep index 0 with zero data, the
+    same harmless convention :func:`sharded_from_dense` uses for padded
+    rows. The sparse analogue of :func:`~stmgcn_tpu.parallel.banded.
+    branch_stack`'s common halo."""
+    dense_stack = np.asarray(dense_stack, dtype=np.float32)
+    per = [
+        _sharded_np(dense_stack[m], n_shards, tile)  # host-side numpy:
+        for m in range(dense_stack.shape[0])  # pad+stack before upload
+    ]
+
+    def pad_c(a, width):
+        extra = width - a.shape[3]
+        if extra == 0:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[3] = (0, extra)
+        return np.pad(a, widths)
+
+    c_max = max(data.shape[3] for data, _, _, _, _ in per)
+    c_max_t = max(data_t.shape[3] for _, _, data_t, _, _ in per)
     return ShardedBlockSparse(
-        data=jnp.asarray(data),
-        idx=jnp.asarray(idx),
-        data_t=jnp.asarray(data_t),
-        idx_t=jnp.asarray(idx_t),
-        n=n,
+        data=jnp.asarray(np.stack([pad_c(d, c_max) for d, _, _, _, _ in per])),
+        idx=jnp.asarray(np.stack([pad_c(i, c_max) for _, i, _, _, _ in per])),
+        data_t=jnp.asarray(np.stack([pad_c(dt, c_max_t) for _, _, dt, _, _ in per])),
+        idx_t=jnp.asarray(np.stack([pad_c(it, c_max_t) for _, _, _, it, _ in per])),
+        n=per[0][4],
         tile=tile,
     )
 

@@ -1,15 +1,17 @@
-"""Branch model parallelism composed with the banded halo-exchange plan.
+"""Branch model parallelism composed with the loop-layout support plans.
 
-Round-4 rejected ``mesh.branch > 1`` with any active region strategy
-(the loop layouts had no stacked branch axis to shard). Round 5 lifts
-it for banded supports: ``route_supports`` stacks every branch's strips
-at a common halo (``parallel.banded.branch_stack``) and the model runs
+Round-4 rejected ``mesh.branch > 1`` with any active region strategy or
+sparse supports (the loop layouts had no stacked branch axis to shard).
+Round 5 lifts both: ``route_supports`` stacks every branch's supports
+into ONE uniform operand — banded strips at a common halo
+(``parallel.banded.branch_stack``) or block-CSR at a common block-column
+width (``parallel.sparse.branch_stack_sparse``) — and the model runs
 ONE vmapped Branch whose vmapped axis is the mesh's ``branch`` axis
-(``nn.vmap(..., spmd_axis_name='branch')``) — the inner ring halo
-exchange then runs per branch group over ``region`` while the branch
-dim shards away. Contract: identical losses/trajectories vs the dense
-single-device reference. (``sparse`` still rejects: the Pallas SpMM has
-no graph-axis batching rule — ``experiment._strategy_active``.)
+(``nn.vmap(..., spmd_axis_name='branch')``). The inner shard_maps (ring
+halo exchange / sharded SpMM) then run per branch group over ``region``
+while the branch dim shards away, so the Pallas SpMM never needs a
+graph-axis batching rule. Contract: identical losses/trajectories vs
+the dense single-device reference.
 """
 
 import jax
@@ -112,22 +114,34 @@ class TestRoutingWithBranchAxis:
         _, modes = route_supports(cfg, ds)
         assert modes is None
 
-    def test_sparse_with_branch_still_rejects(self, eight_devices):
+    def test_sparse_with_branch_stacks(self, eight_devices):
+        from stmgcn_tpu.parallel import ShardedBlockSparse
+
         cfg = self._cfg()
         cfg.model.sparse = True
         ds = build_dataset(cfg)
-        with pytest.raises(ValueError, match="sparse"):
-            route_supports(cfg, ds)
+        sup, modes = route_supports(cfg, ds)
+        assert modes == ("sparse", "sparse")
+        assert isinstance(sup, ShardedBlockSparse) and sup.branch_stacked
+        assert sup.data.shape[0] == 2  # M leading axis
 
 
 @pytest.mark.slow
-class TestBranchBandedParity:
-    """Composed plan == dense single-device reference, same params."""
+class TestBranchStackedParity:
+    """Composed plans == dense single-device reference, same params."""
 
-    def test_forward_and_training_trajectory(self, eight_devices):
+    @pytest.mark.parametrize("mode", ["banded", "sparse"])
+    def test_forward_and_training_trajectory(self, eight_devices, mode):
         rng = np.random.default_rng(0)
         M, K, N, B, T, w = 2, 3, 16, 8, 5, 2
-        dense = _band_supports(M, K, N, w)
+        if mode == "banded":
+            dense = _band_supports(M, K, N, w)
+        else:  # arbitrary sparse structure (block-CSR path)
+            dense = (
+                (rng.random((M, K, N, N)) < 0.3)
+                * rng.normal(size=(M, K, N, N))
+                * 0.2
+            ).astype(np.float32)
         x = rng.standard_normal((B, T, N, 1)).astype(np.float32)
         y = (rng.standard_normal((B, N, 1)) * 0.1).astype(np.float32)
         mask = np.ones(B, np.float32)
@@ -137,12 +151,18 @@ class TestBranchBandedParity:
         kw = dict(m_graphs=M, n_supports=K, seq_len=T, input_dim=1,
                   lstm_hidden_dim=8, lstm_num_layers=2, gcn_hidden_dim=8)
         ref = STMGCN(**kw)
-        composed = STMGCN(**kw, support_modes=("banded",) * M,
+        composed = STMGCN(**kw, support_modes=(mode,) * M,
                           shard_spec=ShardSpec(mesh))
+        if mode == "banded":
+            stacked_host = branch_stack(list(dense), 2)
+        else:
+            from stmgcn_tpu.parallel import branch_stack_sparse
+
+            stacked_host = branch_stack_sparse(dense, 2)
 
         params = ref.init(jax.random.key(0), jnp.asarray(dense), jnp.asarray(x))
         want = ref.apply(params, jnp.asarray(dense), jnp.asarray(x))
-        stacked = pl.put(branch_stack(list(dense), 2), "supports")
+        stacked = pl.put(stacked_host, "supports")
         got = jax.jit(composed.apply)(
             pl.put(params, "state"), stacked, pl.put(x, "x")
         )
@@ -172,6 +192,36 @@ class TestBranchBandedParity:
         # the stacked branch params genuinely shard over the branch axis
         wh = pm["params"]["branches"]["cg_lstm"]["lstm"]["wh_0"]
         assert wh.sharding.spec[0] == "branch"
+
+
+class TestRebuildLayout:
+    def test_sparse_branch_checkpoint_rebuilds_vmapped(self, eight_devices):
+        """A sparse + branch>1 config trains in the vmapped stacked layout;
+        its mesh-less rebuild (Forecaster path: build_model with
+        support_modes=None, dense supports) must produce the SAME param
+        tree, not the sparse loop layout."""
+        from stmgcn_tpu.experiment import build_model
+
+        cfg = preset("smoke")
+        cfg.model.m_graphs = 2
+        cfg.model.sparse = True
+        cfg.mesh.dp, cfg.mesh.region, cfg.mesh.branch = 2, 2, 2
+
+        trained = build_model(
+            cfg, 1, support_modes=("sparse", "sparse"),
+            shard_spec=ShardSpec(build_mesh(dp=2, region=2, branch=2)),
+        )
+        rebuilt = build_model(cfg, 1)  # Forecaster's call: no mesh, no modes
+        assert rebuilt.vmap_branches and not rebuilt.sparse
+        dense = _band_supports(2, cfg.model.n_supports, 16, 2)
+        x = jnp.zeros((2, cfg.data.seq_len, 16, 1))
+        p = rebuilt.init(jax.random.key(0), jnp.asarray(dense), x)
+        assert "branches" in p["params"]  # vmapped stacked layout
+        from stmgcn_tpu.parallel import branch_stack_sparse
+
+        stacked = branch_stack_sparse(dense, 2)
+        p2 = trained.init(jax.random.key(0), stacked, x)
+        assert jax.tree_util.tree_structure(p) == jax.tree_util.tree_structure(p2)
 
 
 class TestModelValidation:

@@ -99,30 +99,36 @@ class TestBranchParallelParity:
 
 
 class TestBranchGuards:
-    def test_branch_rejects_sparse_but_composes_with_banded(self):
+    def test_branch_composes_with_sparse_and_banded(self):
+        from stmgcn_tpu.parallel import ShardedBlockSparse
+
         cfg = preset("smoke")
         cfg.data.n_timesteps = 24 * 7 * 2 + 48
         cfg.mesh.dp, cfg.mesh.branch = 1, 1  # keep n_devices small for build
         cfg.mesh.branch = 2
+        # sparse x branch: stacks regardless of graph structure (block-CSR
+        # handles arbitrary sparsity; round 5, tests/test_branch_banded.py)
+        cfg.model.m_graphs = 2  # grid + random transport links
         cfg.model.sparse = True
-        ds = build_dataset(cfg)
-        with pytest.raises(ValueError, match="sparse"):
-            route_supports(cfg, ds)
-        # an active region strategy no longer rejects wholesale (round 5:
-        # branch-stacked banded strips, tests/test_branch_banded.py).
-        # Budget pinned below the grid bandwidth: 'banded' demands every
-        # branch qualify and raises; 'auto' keeps its contract and falls
-        # back to the fully-supported all-dense GSPMD branch plan
+        ds2 = build_dataset(cfg)
+        sup, modes = route_supports(cfg, ds2)
+        assert modes == ("sparse", "sparse")
+        assert isinstance(sup, ShardedBlockSparse) and sup.branch_stacked
+        # banded x branch needs every branch within the halo budget: the
+        # transport graph (bandwidth ~N) disqualifies, so 'auto' falls
+        # back to the all-dense GSPMD branch plan instead of erroring
         cfg.model.sparse = False
         cfg.mesh.region = 2
-        cfg.mesh.halo = 1
+        cfg.mesh.region_strategy = "auto"
+        _, modes = route_supports(cfg, ds2)
+        assert modes is None  # GSPMD fallback, not an error
+        # ... and 'banded' demands every branch qualify
         cfg.mesh.region_strategy = "banded"
         with pytest.raises(ValueError, match="every branch banded"):
-            route_supports(cfg, ds)
-        cfg.mesh.region_strategy = "auto"
-        _, modes = route_supports(cfg, ds)
-        assert modes is None  # GSPMD fallback, not an error
-        # with an adequate budget the same config routes branch-stacked
+            route_supports(cfg, ds2)
+        # smoke's own single neighborhood graph IS banded: it stacks
+        cfg.model.m_graphs = 1
         cfg.mesh.halo = None
-        sup, modes = route_supports(cfg, ds)
+        ds1 = build_dataset(cfg)
+        sup, modes = route_supports(cfg, ds1)
         assert set(modes) == {"banded"} and sup.branch_stacked

@@ -19,6 +19,12 @@ Plans (the communication layer the reference lacks outright — SURVEY.md
                      (collective-permute), the rest GSPMD
 - ``region8-sparse`` block-CSR row strips per shard
 - ``branch3``        graph branches sharded; sum fusion becomes one psum
+- ``branch2-dense``  (dp=2, region=2, branch=2): branch parallelism
+                     composed with region sharding, dense GSPMD supports
+- ``branch2-sparse`` same mesh, branch-stacked block-CSR strips (round
+                     5: the vmapped branch axis shards the stacked
+                     operand; each branch group all-gathers the signal
+                     over its region ring)
 - ``hetero-region``  heterogeneous city pair on a (dp, region) mesh with
                      per-city node padding; reports the padded city's
                      compiled step (each city shape compiles its own)
@@ -66,6 +72,13 @@ def build_plan(name: str, rows: int, batch: int):
         elif name == "branch3":
             cfg.mesh.dp, cfg.mesh.region, cfg.mesh.branch = 1, 1, 3
             cfg.mesh.region_strategy = "gspmd"
+        elif name in ("branch2-dense", "branch2-sparse"):
+            # the branch extent must divide m_graphs; 2 of the 3
+            # synthetic graphs keep the step architecturally complete
+            cfg.model.m_graphs = 2
+            cfg.mesh.dp, cfg.mesh.region, cfg.mesh.branch = 2, 2, 2
+            cfg.mesh.region_strategy = "gspmd"
+            cfg.model.sparse = name == "branch2-sparse"
         else:
             raise ValueError(name)
     cfg.train.batch_size = batch
@@ -129,6 +142,8 @@ PLANS = (
     "region8-auto",
     "region8-sparse",
     "branch3",
+    "branch2-dense",
+    "branch2-sparse",
     "hetero-region",
 )
 

@@ -32,7 +32,8 @@ OUT = os.path.join(REPO, "benchmarks", "scaled_accuracy.json")
 def main() -> None:
     from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
 
-    lock = BenchLock()
+    lock_path = os.environ.get("STMGCN_BENCH_LOCK_PATH")
+    lock = BenchLock(lock_path) if lock_path else BenchLock()
     lock.acquire(wait_s=float(os.environ.get("STMGCN_BENCH_LOCK_WAIT", 300)))
     load_before = host_load_snapshot()
 

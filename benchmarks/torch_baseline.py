@@ -79,6 +79,19 @@ class MultiGraphForecaster(nn.Module):
 
 
 def main() -> None:
+    # the anchor is a measurement like any other: serialize on the host
+    # bench lock and carry load provenance so anchor and candidate are
+    # comparable same-host, same-regime (stmgcn_tpu/utils/hostload.py)
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
+
+    lock_path = os.environ.get("STMGCN_BENCH_LOCK_PATH")
+    lock = BenchLock(lock_path) if lock_path else BenchLock()
+    lock.acquire(wait_s=float(os.environ.get("STMGCN_BENCH_LOCK_WAIT", 300)))
+    load_before = host_load_snapshot()
+
     device = "cuda" if torch.cuda.is_available() else "cpu"
     torch.manual_seed(0)
     seq_len = SERIAL + DAILY + WEEKLY
@@ -124,11 +137,18 @@ def main() -> None:
                    "m_graphs": 3, "n_supports": 3},
         "step_seconds": dt,
         "final_loss": float(loss.detach()),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host_load": {
+            "before": load_before,
+            "after": host_load_snapshot(),
+            "lock": lock.record(),
+        },
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
+    lock.release()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Torch-side component timings matching step_breakdown.py's legs (CPU).
+
+Round-5 re-anchoring found torch 28-37% faster than the XLA:CPU
+fallback at the canonical point on the current host. This script times
+the torch implementation's components at the SAME shapes as
+``step_breakdown.py``'s JAX legs — the pairing attributes the gap to a
+primitive (oneDNN's fused RNN vs the XLA scan; GEMM conv vs einsum)
+instead of leaving it a mystery ratio:
+
+- ``torch/lstm``: M branches' ``nn.LSTM`` fwd+bwd at the model's folded
+  shapes (R = B*N rows, T steps, 1 feature in, H hidden, L layers) —
+  the component the analytic model says is ~93% of step FLOPs.
+- ``torch/conv``: the K-support einsum + projection fwd+bwd at both
+  conv sites' shapes.
+- ``torch/step``: the full train step (same as torch_baseline.py, fewer
+  iters) for the denominator.
+
+One JSON line per measurement, lock + host-load provenance in a trailer
+record. Shapes come from bench.py's canonical constants so the pairing
+cannot drift.
+
+Usage: python benchmarks/torch_components.py
+Env: STMGCN_BENCH_{ROWS,BATCH,WARMUP,ITERS} narrow the point (as in
+bench.py); STMGCN_BENCH_LOCK_PATH/_LOCK_WAIT as everywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench as bench_mod  # noqa: E402 — the one canonical-point definition
+
+ROWS, BATCH = bench_mod.ROWS, bench_mod.BATCH
+T = bench_mod.SERIAL + bench_mod.DAILY + bench_mod.WEEKLY
+H, L = bench_mod.LSTM_HIDDEN, bench_mod.LSTM_LAYERS
+M, K = bench_mod.M_GRAPHS, bench_mod.K_SUPPORTS
+GCN_HIDDEN = bench_mod.GCN_HIDDEN
+WARMUP = int(os.environ.get("STMGCN_BENCH_WARMUP", 2))
+ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 5))
+
+
+def _time(fn, warmup=WARMUP, iters=ITERS) -> float:
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def _emit(name: str, seconds: float, extra=None) -> None:
+    rec = {"component": name, "dtype": "float32", "ms": round(seconds * 1e3, 3)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def main() -> None:
+    from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
+
+    lock_path = os.environ.get("STMGCN_BENCH_LOCK_PATH")
+    lock = BenchLock(lock_path) if lock_path else BenchLock()
+    lock.acquire(wait_s=float(os.environ.get("STMGCN_BENCH_LOCK_WAIT", 300)))
+    load_before = host_load_snapshot()
+
+    import numpy as np
+    import torch
+    from torch import nn
+
+    torch.manual_seed(0)
+    n = ROWS * ROWS
+    rows = BATCH * n  # the model folds nodes into batch for the LSTM
+    rng = np.random.default_rng(0)
+
+    # --- lstm: M branches' fused oneDNN recurrence, fwd + bwd.
+    # Mirrors step_breakdown.measure_lstm EXACTLY: input feature dim
+    # GCN_HIDDEN (the breakdown's chosen width, not the model's d_in=1),
+    # loss = sum of ALL timesteps' outputs squared, M real branch passes.
+    lstms = [nn.LSTM(GCN_HIDDEN, H, num_layers=L, batch_first=True) for _ in range(M)]
+    xs = torch.tensor(rng.standard_normal((rows, T, GCN_HIDDEN)).astype(np.float32))
+
+    def lstm_leg():
+        total = 0.0
+        for rnn in lstms:
+            rnn.zero_grad()
+            out, _ = rnn(xs)
+            loss = out.square().sum()
+            loss.backward()
+            total += float(loss.detach())
+        return total
+
+    _emit(
+        "torch/lstm",
+        _time(lstm_leg),
+        {"rows": rows, "T": T, "d_in": GCN_HIDDEN, "H": H, "L": L, "m_branches": M},
+    )
+
+    # --- conv: M branches' K-support einsum + (K*f -> GCN_HIDDEN) matmul,
+    # fwd + bwd — same contraction, projection width, and loss as
+    # step_breakdown.measure_conv (no bias/relu there either)
+    sup_b = torch.tensor((rng.standard_normal((M, K, n, n)) * 0.1).astype(np.float32))
+    for site, f_in in (("seq", T), ("hidden", H)):
+        ws = [
+            torch.tensor(
+                (rng.standard_normal((K * f_in, GCN_HIDDEN)) * 0.1).astype(np.float32),
+                requires_grad=True,
+            )
+            for _ in range(M)
+        ]
+        sig = torch.tensor(
+            rng.standard_normal((M, BATCH, n, f_in)).astype(np.float32)
+        )
+
+        def conv_leg():
+            total = 0.0
+            for m in range(M):
+                if ws[m].grad is not None:
+                    ws[m].grad = None
+                kx = torch.einsum("kij,bjf->bikf", sup_b[m], sig[m]).flatten(2)
+                loss = (kx @ ws[m]).square().sum()
+                loss.backward()
+                total += float(loss.detach())
+            return total
+
+        _emit(
+            f"torch/conv-{site}",
+            _time(conv_leg),
+            {"batch": BATCH, "n_nodes": n, "f_in": f_in,
+             "f_out": GCN_HIDDEN, "m_branches": M},
+        )
+
+    # --- full step (torch_baseline's model; same warmup/iters as the
+    # component legs so component-vs-step arithmetic is meaningful) ---
+    from torch_baseline import MultiGraphForecaster
+
+    model = MultiGraphForecaster(m=M, k=K, seq_len=T, d_in=1)
+    opt = torch.optim.Adam(model.parameters(), lr=2e-3, weight_decay=1e-4)
+    crit = nn.MSELoss()
+    sup_stack = torch.tensor(
+        (rng.standard_normal((M, K, n, n)) * 0.1).astype(np.float32)
+    )
+    x = torch.tensor(rng.standard_normal((BATCH, T, n, 1)).astype(np.float32))
+    y = torch.tensor(rng.standard_normal((BATCH, n, 1)).astype(np.float32) * 0.1)
+
+    def step():
+        opt.zero_grad()
+        loss = crit(model(sup_stack, x), y)
+        loss.backward()
+        opt.step()
+        return loss
+
+    _emit("torch/step", _time(step))
+
+    print(
+        json.dumps(
+            {
+                "component": "provenance",
+                "torch_version": torch.__version__,
+                "threads": torch.get_num_threads(),
+                "host_load": {
+                    "before": load_before,
+                    "after": host_load_snapshot(),
+                    "lock": lock.record(),
+                },
+            }
+        )
+    )
+    lock.release()
+
+
+if __name__ == "__main__":
+    main()

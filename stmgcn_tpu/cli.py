@@ -108,7 +108,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--branch-parallel", type=_positive_int, default=None,
                    metavar="B",
                    help="shard the M graph branches over a 'branch' mesh "
-                        "axis of extent B (dense vmapped mode only)")
+                        "axis of extent B (composes with dense GSPMD, "
+                        "banded, and sparse supports; B must divide "
+                        "m_graphs)")
     p.add_argument("--region-strategy", choices=("gspmd", "banded", "auto"),
                    default=None,
                    help="region-sharded conv plan: XLA's automatic (gspmd), "

@@ -48,3 +48,48 @@ def test_lock_released_on_context_exit(tmp_path):
     again = BenchLock(path)
     assert again.acquire(wait_s=0.5, poll_s=0.05) is True
     again.release()
+
+
+def _hold_lock(path):
+    import time as _time
+
+    lock = BenchLock(path)
+    assert lock.acquire(wait_s=5)
+    with open(path + ".held", "w") as f:
+        f.write("1")
+    _time.sleep(30)  # parent kills us long before this expires
+
+
+def test_lock_excludes_across_processes(tmp_path):
+    """The real deployment shape: bench.py in one process, the recovery
+    loop in another. Also pins kernel-release-on-death (a killed holder
+    must not leave a stale lock). Fork context deliberately: a spawn
+    child would re-import this module -> the stmgcn_tpu package -> jax,
+    which on this image can dial the wedged axon tunnel and hang.
+    Handshake via a sentinel file, not mp.Event — a SIGKILLed holder of
+    an Event semaphore wedges multiprocessing's teardown."""
+    import multiprocessing as mp
+    import time
+
+    ctx = mp.get_context("fork")
+    path = str(tmp_path / "bench.lock")
+    child = ctx.Process(target=_hold_lock, args=(path,), daemon=True)
+    child.start()
+    try:
+        deadline = time.monotonic() + 20
+        while not os.path.exists(path + ".held"):
+            assert child.is_alive(), f"child died early, exitcode {child.exitcode}"
+            assert time.monotonic() < deadline, "child never acquired"
+            time.sleep(0.05)
+        mine = BenchLock(path)
+        assert mine.acquire(wait_s=0.3, poll_s=0.05) is False
+        assert mine.record()["holder_pid"] == child.pid
+        # killed holder: the kernel releases the flock with the process
+        child.kill()
+        child.join(10)
+        assert mine.acquire(wait_s=5, poll_s=0.1) is True
+        mine.release()
+    finally:
+        if child.is_alive():
+            child.kill()
+        child.join(5)

@@ -30,31 +30,14 @@ OUT = os.path.join(REPO, "benchmarks", "scaled_accuracy.json")
 
 
 def main() -> None:
-    from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
+    from stmgcn_tpu.utils.hostload import (
+        host_load_snapshot,
+        measurement_preamble,
+        probe_backend_child,
+    )
 
-    lock_path = os.environ.get("STMGCN_BENCH_LOCK_PATH")
-    lock = BenchLock(lock_path) if lock_path else BenchLock()
-    lock.acquire(wait_s=float(os.environ.get("STMGCN_BENCH_LOCK_WAIT", 300)))
-    load_before = host_load_snapshot()
-
-    # probe in a killable child (the in-process backend init can hang on a
-    # wedged tunnel) — same discipline as bench.py
-    import subprocess
-
-    from stmgcn_tpu.utils.hostload import PROBE_SRC
-
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC], timeout=120, capture_output=True
-        )
-        backend = (
-            probe.stdout.decode().strip().splitlines()[-1]
-            if probe.returncode == 0
-            else None
-        )
-    except subprocess.TimeoutExpired:
-        backend = None
-    on_tpu = backend == "tpu"
+    lock, load_before = measurement_preamble()
+    on_tpu = probe_backend_child() == "tpu"
     if not on_tpu:
         from stmgcn_tpu.utils import force_host_platform
 
@@ -98,10 +81,25 @@ def main() -> None:
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     # cpu-fallback records are proof-of-path only: never overwrite an
-    # on-chip record with one
-    if on_tpu or not os.path.exists(OUT):
+    # on-chip record with one (refreshing a cpu-fallback record is fine);
+    # the record says which happened
+    persist = on_tpu or not os.path.exists(OUT)
+    if not persist:
+        try:
+            with open(OUT) as f:
+                persist = json.load(f).get("platform") != "tpu"
+        except (OSError, json.JSONDecodeError):
+            persist = True
+    record["persisted"] = persist
+    if persist:
         with open(OUT, "w") as f:
             json.dump(record, f, indent=1)
+    else:
+        print(
+            f"scaled_accuracy: NOT overwriting on-chip record {OUT} with a "
+            "cpu-fallback run",
+            file=sys.stderr,
+        )
     print(json.dumps(record))
     lock.release()
 

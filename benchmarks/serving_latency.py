@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Serving-path latency at the canonical shapes (one chip or CPU).
+
+Training throughput is bench.py's story; this measures the OTHER path a
+user of the reference cannot even take (the reference has no inference
+entry point at all — SURVEY.md C12 covers test-time scoring only):
+
+- ``forecaster``: :class:`stmgcn_tpu.inference.Forecaster` — checkpoint
+  -> rebuilt model -> jitted predict (normalize, forward, denormalize).
+- ``exported``: :class:`stmgcn_tpu.export.ExportedForecaster` — the AOT
+  serving artifact, loaded WITHOUT the model stack in a fresh process.
+
+Both measured at batch 1 (interactive latency) and the training batch
+(throughput serving), at the default preset's shapes (16x16 grid,
+T=5), after a warmup call (compile excluded — serving processes are
+long-lived). Trains a
+2-epoch throwaway checkpoint first; accuracy is irrelevant here, only
+the compiled prediction path's wall-clock. Writes
+``benchmarks/serving_latency.json`` with lock + host-load provenance
+(cpu-fallback records never overwrite an on-chip record).
+
+Usage: python benchmarks/serving_latency.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "benchmarks", "serving_latency.json")
+
+
+def _timed(fn, warmup=2, iters=20) -> float:
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    from stmgcn_tpu.utils.hostload import (
+        host_load_snapshot,
+        measurement_preamble,
+        probe_backend_child,
+    )
+
+    lock, load_before = measurement_preamble()
+    on_tpu = probe_backend_child() == "tpu"
+    if not on_tpu:
+        from stmgcn_tpu.utils import force_host_platform
+
+        force_host_platform("cpu")
+
+    import numpy as np
+
+    from stmgcn_tpu.config import preset
+    from stmgcn_tpu.experiment import build_trainer
+
+    cfg = preset("default")
+    cfg.data.rows = 16
+    cfg.data.n_timesteps = 24 * 7 * 2 + 64
+    cfg.train.epochs = 2
+    cfg.train.batch_size = 16
+    tmp = tempfile.mkdtemp(prefix="stmgcn_serving_")
+    cfg.train.out_dir = tmp
+    trainer = build_trainer(cfg, verbose=False)
+    trainer.train()
+
+    from stmgcn_tpu.export import ExportedForecaster, export_forecaster
+    from stmgcn_tpu.inference import Forecaster
+
+    fc = Forecaster.from_checkpoint(os.path.join(tmp, "best.ckpt"))
+    export_path = os.path.join(tmp, "model.stmgx")
+    export_forecaster(fc, export_path)
+    ex = ExportedForecaster.load(export_path)
+    ds = trainer.dataset
+    supports = np.asarray(cfg.model.support_config.build_all(ds.adjs.values()))
+    seq_len, n, c = cfg.data.seq_len, ds.n_nodes, ds.n_feats
+    rng = np.random.default_rng(0)
+
+    legs = {}
+    for batch in (1, cfg.train.batch_size):
+        history = (rng.random((batch, seq_len, n, c)) * 50).astype(np.float32)
+        for name, predictor in (("forecaster", fc), ("exported", ex)):
+            s = _timed(lambda p=predictor, h=history: p.predict(supports, h))
+            legs[f"{name}/b{batch}"] = {
+                "ms": round(s * 1e3, 3),
+                "predictions_per_sec": round(batch / s, 1),
+            }
+
+    record = {
+        "operating_point": f"serving-16x16-T{seq_len}",
+        "platform": "tpu" if on_tpu else "cpu-fallback",
+        "legs": legs,
+        "host_load": {
+            "before": load_before,
+            "after": host_load_snapshot(),
+            "lock": lock.record(),
+        },
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    # an on-chip record always persists; a cpu-fallback record persists
+    # only when no on-chip record exists yet (and refreshes a previous
+    # cpu-fallback one) — and the record says which happened
+    persist = on_tpu or not os.path.exists(OUT)
+    if not persist:
+        try:
+            with open(OUT) as f:
+                persist = json.load(f).get("platform") != "tpu"
+        except (OSError, json.JSONDecodeError):
+            persist = True
+    record["persisted"] = persist
+    if persist:
+        with open(OUT, "w") as f:
+            json.dump(record, f, indent=1)
+    else:
+        print(
+            f"serving_latency: NOT overwriting on-chip record {OUT} with a "
+            "cpu-fallback run",
+            file=sys.stderr,
+        )
+    print(json.dumps(record))
+    lock.release()
+
+
+if __name__ == "__main__":
+    main()

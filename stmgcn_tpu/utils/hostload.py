@@ -100,6 +100,39 @@ def host_load_snapshot() -> dict:
     }
 
 
+def probe_backend_child(timeout_s: int = 120) -> Optional[str]:
+    """Resolve the backend in a killable child; ``None`` when it never
+    answers. The ONE probe implementation the measurement scripts share
+    (a wedged axon tunnel blocks backend init inside native code where
+    signal handlers never run — probing in-process is a 10-minute hang).
+    Safe against a zero-returncode child with empty stdout."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    lines = out.stdout.decode().strip().splitlines()
+    return lines[-1] if lines else None
+
+
+def measurement_preamble(wait_env: str = "STMGCN_BENCH_LOCK_WAIT"):
+    """Standard start of every measurement script: acquire the host-wide
+    bench lock (honoring ``STMGCN_BENCH_LOCK_PATH``) and snapshot the
+    load regime. Returns ``(lock, load_before)``."""
+    lock_path = os.environ.get("STMGCN_BENCH_LOCK_PATH")
+    lock = BenchLock(lock_path) if lock_path else BenchLock()
+    lock.acquire(wait_s=float(os.environ.get(wait_env, 300)))
+    return lock, host_load_snapshot()
+
+
 class BenchLock:
     """Advisory host-wide measurement lock (``flock`` on :data:`LOCK_PATH`).
 

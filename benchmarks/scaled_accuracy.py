@@ -80,26 +80,9 @@ def main() -> None:
         },
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
-    # cpu-fallback records are proof-of-path only: never overwrite an
-    # on-chip record with one (refreshing a cpu-fallback record is fine);
-    # the record says which happened
-    persist = on_tpu or not os.path.exists(OUT)
-    if not persist:
-        try:
-            with open(OUT) as f:
-                persist = json.load(f).get("platform") != "tpu"
-        except (OSError, json.JSONDecodeError):
-            persist = True
-    record["persisted"] = persist
-    if persist:
-        with open(OUT, "w") as f:
-            json.dump(record, f, indent=1)
-    else:
-        print(
-            f"scaled_accuracy: NOT overwriting on-chip record {OUT} with a "
-            "cpu-fallback run",
-            file=sys.stderr,
-        )
+    from stmgcn_tpu.utils.hostload import persist_measurement
+
+    persist_measurement(OUT, record, on_tpu, "scaled_accuracy")
     print(json.dumps(record))
     lock.release()
 

@@ -106,26 +106,9 @@ def main() -> None:
         },
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
-    # an on-chip record always persists; a cpu-fallback record persists
-    # only when no on-chip record exists yet (and refreshes a previous
-    # cpu-fallback one) — and the record says which happened
-    persist = on_tpu or not os.path.exists(OUT)
-    if not persist:
-        try:
-            with open(OUT) as f:
-                persist = json.load(f).get("platform") != "tpu"
-        except (OSError, json.JSONDecodeError):
-            persist = True
-    record["persisted"] = persist
-    if persist:
-        with open(OUT, "w") as f:
-            json.dump(record, f, indent=1)
-    else:
-        print(
-            f"serving_latency: NOT overwriting on-chip record {OUT} with a "
-            "cpu-fallback run",
-            file=sys.stderr,
-        )
+    from stmgcn_tpu.utils.hostload import persist_measurement
+
+    persist_measurement(OUT, record, on_tpu, "serving_latency")
     print(json.dumps(record))
     lock.release()
 

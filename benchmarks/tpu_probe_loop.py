@@ -43,7 +43,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from stmgcn_tpu.utils.hostload import PROBE_SRC, BenchLock  # noqa: E402
+from stmgcn_tpu.utils.hostload import (  # noqa: E402
+    BenchLock,
+    probe_backend_child,
+)
 
 DONE_MARKER = "/tmp/stmgcn_probe_done"
 PROBE_TIMEOUT_S = int(os.environ.get("STMGCN_PROBE_TIMEOUT", 120))
@@ -61,26 +64,20 @@ def log(msg: str) -> None:
 def probe_once() -> bool:
     """One killable backend probe under the bench lock. True iff the
     resolved backend is a real TPU (a plugin-less host 'succeeds' on CPU
-    and must not trigger the runbook)."""
+    and must not trigger the runbook). The probe itself is the shared
+    ``probe_backend_child`` — one implementation everywhere, and immune
+    to a rc=0 child with empty stdout killing the watcher."""
     lock = BenchLock()
     if not lock.acquire(wait_s=30):
         log(f"bench lock held by pid {lock.holder_pid()}; standing down")
         return False
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC],
-            timeout=PROBE_TIMEOUT_S,
-            capture_output=True,
-        )
-        if out.returncode != 0:
-            log("probe failed: " + out.stderr.decode()[-200:].replace("\n", " "))
+        backend = probe_backend_child(timeout_s=PROBE_TIMEOUT_S)
+        if backend is None:
+            log(f"probe failed or timed out after {PROBE_TIMEOUT_S}s (tunnel wedged)")
             return False
-        backend = out.stdout.decode().strip().splitlines()[-1]
         log(f"probe resolved backend: {backend}")
         return backend == "tpu"
-    except subprocess.TimeoutExpired:
-        log(f"probe timed out after {PROBE_TIMEOUT_S}s (tunnel wedged)")
-        return False
     finally:
         lock.release()
 
@@ -152,9 +149,16 @@ def runbook() -> bool:
             3600,
             True,
         ),
-        # the scaled accuracy leg takes the bench lock itself (it IS a
-        # measurement process like bench.py) — spawning it under the
-        # parent's hold would deadlock
+        # these two take the bench lock themselves (they ARE measurement
+        # processes like bench.py) — spawning them under the parent's
+        # hold would deadlock
+        (
+            "serving-latency",
+            [py, "benchmarks/serving_latency.py"],
+            {},
+            1800,
+            False,
+        ),
         (
             "scaled-accuracy",
             [py, "benchmarks/scaled_accuracy.py"],

@@ -133,6 +133,35 @@ def measurement_preamble(wait_env: str = "STMGCN_BENCH_LOCK_WAIT"):
     return lock, host_load_snapshot()
 
 
+def persist_measurement(out_path: str, record: dict, on_tpu: bool, label: str) -> bool:
+    """The ONE evidence-file overwrite policy: an on-chip record always
+    persists; a cpu-fallback record persists only when the existing file
+    is absent, unreadable, or itself cpu-fallback — never over on-chip
+    evidence. Sets ``record["persisted"]`` so the printed record says
+    which happened, and returns it."""
+    import json
+    import sys
+
+    persist = on_tpu or not os.path.exists(out_path)
+    if not persist:
+        try:
+            with open(out_path) as f:
+                persist = json.load(f).get("platform") != "tpu"
+        except (OSError, ValueError):
+            persist = True
+    record["persisted"] = persist
+    if persist:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1)
+    else:
+        print(
+            f"{label}: NOT overwriting on-chip record {out_path} with a "
+            "cpu-fallback run",
+            file=sys.stderr,
+        )
+    return persist
+
+
 class BenchLock:
     """Advisory host-wide measurement lock (``flock`` on :data:`LOCK_PATH`).
 

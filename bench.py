@@ -613,6 +613,22 @@ def main() -> None:
                 record["scaled_tpu"] = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             print(f"bench: could not read scaled last-good: {e}", file=sys.stderr)
+    # compact summaries of the other evidence files (accuracy at scale,
+    # serving latency) so the driver's one record carries the round's
+    # whole measurement story with their platform provenance attached
+    for key, fname, fields in (
+        ("scaled_accuracy", "scaled_accuracy.json", ("test", "platform", "captured_at")),
+        ("serving", "serving_latency.json", ("legs", "platform", "captured_at")),
+    ):
+        path = os.path.join(BENCH_DIR, fname)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    ev = json.load(f)
+                if isinstance(ev, dict):  # a mangled file must not void
+                    record[key] = {k: ev.get(k) for k in fields}  # the record
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"bench: could not read {fname}: {e}", file=sys.stderr)
     _emit(record)
 
 

@@ -56,6 +56,10 @@ def test_canonical_record_shape():
     for snap in (load["before"], load["after"]):
         assert snap["nproc"] >= 1
         assert isinstance(snap["competing_python"], list)
+    # auxiliary evidence files ride along with platform provenance — both
+    # are committed (cpu-fallback or better), so attachment must fire
+    for key in ("scaled_accuracy", "serving"):
+        assert rec[key]["platform"] in ("tpu", "cpu-fallback"), rec.get(key)
 
 
 def test_scaled_mode_record():

@@ -404,16 +404,16 @@ def main() -> None:
             f"STMGCN_BENCH_DTYPE must be float32|bfloat16|both, got {DTYPE!r}"
         )
     from stmgcn_tpu.utils import force_host_platform
-    from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
+    from stmgcn_tpu.utils.hostload import measurement_preamble
 
     # Serialize against the tunnel-probe loop (and any other bench) before
     # measuring anything: on this 1-core host the competing process IS the
-    # measurement error. On timeout we proceed anyway — a flagged record
-    # beats no record — and lock.record() says who held it.
-    lock_path = os.environ.get("STMGCN_BENCH_LOCK_PATH")
-    lock = BenchLock(lock_path) if lock_path else BenchLock()
-    lock.acquire(float(os.environ.get("STMGCN_BENCH_LOCK_WAIT", 300)))
-    load_before = host_load_snapshot()
+    # measurement error. The shared preamble acquires the host-wide lock
+    # (proceeding flagged-but-unblocked on timeout — lock.record() says
+    # who held it), drains lingering — possibly unkillable D-state —
+    # probe children (one depressed the round-5 driver sim ~10%; its
+    # host_load field caught it), and snapshots the load regime.
+    lock, load_before = measurement_preamble()
 
     # STMGCN_BENCH_PLATFORM=cpu pins the host platform (skipping the TPU
     # probe entirely) — for validating the full success path on hosts

@@ -97,6 +97,13 @@ def run_leg(
     if lock is not None and not lock.acquire(wait_s=600):
         log(f"leg {name}: bench lock busy (pid {lock.holder_pid()}); skipping")
         return False
+    if lock is not None:
+        # take_lock legs (breakdown/sweep) have no preamble of their own:
+        # drain lingering probe children for them like bench.py does for
+        # itself, or they measure against the wedged child
+        from stmgcn_tpu.utils.hostload import wait_for_probe_children
+
+        wait_for_probe_children()
     try:
         out = subprocess.run(
             argv, cwd=REPO, env=env, timeout=timeout_s, capture_output=True

@@ -123,13 +123,38 @@ def probe_backend_child(timeout_s: int = 120) -> Optional[str]:
     return lines[-1] if lines else None
 
 
+def wait_for_probe_children(max_wait_s: float = 150.0, poll_s: float = 5.0) -> bool:
+    """Wait (bounded) for lingering backend-probe children to die.
+
+    A probe child blocked dialing the wedged tunnel can stick in
+    uninterruptible sleep past its parent's SIGKILL and depress a
+    concurrent measurement ~10% on this 1-core host (seen in the wild:
+    round-5 driver-sim record flagged exactly this in ``host_load``).
+    The probe snippet is recognizable by its ``jnp.ones((8, 8))``
+    matmul. Returns True when no probe child remains."""
+    # derived from PROBE_SRC (its leading chars appear verbatim in the
+    # child's cmdline brief): an edit to the one probe snippet must not
+    # silently turn this drain into a no-op
+    marker = PROBE_SRC[:40]
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        lingering = [
+            p for p in _competing_python() if marker in p["cmd"]
+        ]
+        if not lingering or time.monotonic() >= deadline:
+            return not lingering
+        time.sleep(poll_s)
+
+
 def measurement_preamble(wait_env: str = "STMGCN_BENCH_LOCK_WAIT"):
     """Standard start of every measurement script: acquire the host-wide
-    bench lock (honoring ``STMGCN_BENCH_LOCK_PATH``) and snapshot the
-    load regime. Returns ``(lock, load_before)``."""
+    bench lock (honoring ``STMGCN_BENCH_LOCK_PATH``), let lingering
+    probe children drain, and snapshot the load regime. Returns
+    ``(lock, load_before)``."""
     lock_path = os.environ.get("STMGCN_BENCH_LOCK_PATH")
     lock = BenchLock(lock_path) if lock_path else BenchLock()
     lock.acquire(wait_s=float(os.environ.get(wait_env, 300)))
+    wait_for_probe_children()
     return lock, host_load_snapshot()
 
 

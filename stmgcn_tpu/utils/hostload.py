@@ -41,6 +41,10 @@ PROBE_SRC = (
     "print(jax.default_backend())"
 )
 
+#: how a probe child is recognized in a /proc cmdline brief (derived, so
+#: an edit to PROBE_SRC can never strand the drain on a stale pattern)
+PROBE_MARKER = PROBE_SRC[:40]
+
 
 def _competing_python(max_procs: int = 16) -> list[dict]:
     """Python processes on the host other than this one and its ancestors.
@@ -132,14 +136,10 @@ def wait_for_probe_children(max_wait_s: float = 150.0, poll_s: float = 5.0) -> b
     round-5 driver-sim record flagged exactly this in ``host_load``).
     The probe snippet is recognizable by its ``jnp.ones((8, 8))``
     matmul. Returns True when no probe child remains."""
-    # derived from PROBE_SRC (its leading chars appear verbatim in the
-    # child's cmdline brief): an edit to the one probe snippet must not
-    # silently turn this drain into a no-op
-    marker = PROBE_SRC[:40]
     deadline = time.monotonic() + max_wait_s
     while True:
         lingering = [
-            p for p in _competing_python() if marker in p["cmd"]
+            p for p in _competing_python() if PROBE_MARKER in p["cmd"]
         ]
         if not lingering or time.monotonic() >= deadline:
             return not lingering

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Compile the fused Pallas LSTM under REAL Mosaic — no chip required.
+
+The axon terminal compiles TPU programs through a chipless AOT helper
+(``TpuAotCompiler`` behind ``remote_compile``), reachable via JAX's
+topology API (``jax.experimental.topologies.get_topology_desc``) even
+when device init is wedged — which is how round 4's driver bench left
+the two concrete kernel failures this tool exists to chase
+(``bench_stderr.log``, 2026-07-29):
+
+- fp32 forward kernel: VMEM stack OOM — 18.04 MB scoped allocation vs
+  the 16 MB limit at the pre-packing 128-row block calibration
+  (addressed: ``_block_rows`` halved its bases, see ops/pallas_lstm.py);
+- bf16: ``infer-vector-layout: unsupported shape cast``
+  (``vector<128x64xbf16> -> vector<1x1x128x1x64xbf16>``) somewhere in
+  the vmapped lowering of the packed kernel.
+
+Each configuration {bf16, fp32} x {fwd, grad} x {plain, vmapped M=3}
+compiles in a KILLABLE child process under the bench lock (the compile
+rides the same tunnel that wedges, and concurrent libtpu inits fight
+over /tmp/libtpu_lockfile), one JSON line per config with the tail of
+the compiler error on failure. Exit 0 iff every configuration compiles.
+
+Run it the moment the tunnel's compile path answers — it settles "does
+the kernel build under real Mosaic" in minutes, before the chip itself
+is even usable for timing. The recovery watcher pre-gates every cycle
+with ``--probe`` (a trivial-kernel compile, cheap fail-fast) and runs
+the full check the moment the compile path answers, independent of
+device recovery. Any run that produced at least one REAL verdict (a
+success or an actual compiler error, not a pure timeout) persists
+``benchmarks/mosaic_compile_verdict.json``.
+
+Usage: python benchmarks/mosaic_compile_check.py [timeout_s_per_config]
+       python benchmarks/mosaic_compile_check.py --probe   # path check
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+VERDICT_PATH = os.path.join(REPO, "benchmarks", "mosaic_compile_verdict.json")
+TIMEOUT_MSG = "compile did not finish"
+
+PROBE_COMPILE_SRC = """
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental import pallas as pl
+
+topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2x1")
+mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+
+def kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+def f(x):
+    return pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    )(x)
+
+x = jax.ShapeDtypeStruct(
+    (256, 256), jnp.float32, sharding=NamedSharding(mesh, P())
+)
+jax.jit(f).lower(x).compile()
+print("PROBE_COMPILE_OK")
+"""
+
+CHILD_SRC = """
+import sys
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, {repo!r})
+from stmgcn_tpu.ops.pallas_lstm import fused_lstm
+
+dtype = jnp.bfloat16 if {dtype!r} == "bfloat16" else jnp.float32
+mode, vmapped = {mode!r}, {vmapped!r} == "vmap"
+
+topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2x1")
+mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+sh = NamedSharding(mesh, P())
+
+M, R, T, L, H = 3, 16384, 12, 3, 64
+
+def one(xp, wh, wx, b):
+    hs, hf, cf = fused_lstm(xp, wh, wx, b)
+    return jnp.sum(hs.astype(jnp.float32) ** 2) + jnp.sum(hf.astype(jnp.float32))
+
+def scalar(*args):
+    if vmapped:
+        return jnp.sum(jax.vmap(one)(*args))
+    return one(*args)
+
+fn = jax.grad(lambda a: scalar(*a)) if mode == "grad" else scalar
+lead = (M,) if vmapped else ()
+args = tuple(
+    jax.ShapeDtypeStruct(lead + s, dtype, sharding=sh)
+    for s in ((R, T, 4 * H), (L, H, 4 * H), (L - 1, H, 4 * H), (L - 1, 4 * H))
+)
+jax.jit(fn).lower(args if mode == "grad" else args[0],
+                  *(() if mode == "grad" else args[1:])).compile()
+print("COMPILE_OK")
+"""
+
+
+def check(dtype: str, mode: str, vmapped: str, timeout_s: int) -> dict:
+    src = CHILD_SRC.format(repo=REPO, dtype=dtype, mode=mode, vmapped=vmapped)
+    rec = {"config": f"{dtype}/{mode}/{vmapped}"}
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", src], timeout=timeout_s, capture_output=True
+        )
+    except subprocess.TimeoutExpired:
+        rec["ok"] = False
+        rec["error"] = f"{TIMEOUT_MSG} in {timeout_s}s (tunnel wedged?)"
+        return rec
+    rec["ok"] = out.returncode == 0 and b"COMPILE_OK" in out.stdout
+    if not rec["ok"]:
+        err = out.stderr.decode(errors="replace")
+        # surface the Mosaic/VMEM line if present, else the tail
+        key_lines = [
+            ln for ln in err.splitlines()
+            if "Mosaic" in ln or "vmem" in ln.lower() or "Error" in ln
+        ]
+        rec["error"] = ("\n".join(key_lines[-4:]) or err[-500:])[-800:]
+    return rec
+
+
+def _real_error(err: str) -> bool:
+    """A compiler verdict, as opposed to tunnel/infra trouble."""
+    infra = (TIMEOUT_MSG, "UNAVAILABLE", "initialize backend", "libtpu_lockfile")
+    return bool(err) and not any(marker in err for marker in infra)
+
+
+def probe_compile_path(timeout_s: int = 150) -> bool:
+    """Cheap gate: does the chipless AOT compile path answer at all?"""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_COMPILE_SRC],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return out.returncode == 0 and b"PROBE_COMPILE_OK" in out.stdout
+
+
+def main() -> None:
+    import time
+
+    from stmgcn_tpu.utils.hostload import measurement_preamble
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        lock, _ = measurement_preamble()
+        ok = probe_compile_path()
+        lock.release()
+        print(json.dumps({"compile_path": "up" if ok else "down"}))
+        sys.exit(0 if ok else 1)
+
+    timeout_s = int(sys.argv[1]) if len(sys.argv) > 1 else 900
+    lock, _ = measurement_preamble()  # libtpu lockfile + 1-core serialization
+    ok_all, results = True, []
+    for dtype in ("bfloat16", "float32"):
+        for mode in ("fwd", "grad"):
+            for vmapped in ("plain", "vmap"):
+                rec = check(dtype, mode, vmapped, timeout_s)
+                ok_all &= rec["ok"]
+                results.append(rec)
+                print(json.dumps(rec), flush=True)
+    lock.release()
+    # a run that produced at least one REAL verdict (success or an actual
+    # compiler error — not a timeout and not tunnel-infrastructure
+    # trouble like 'UNAVAILABLE ... initialize backend') is evidence
+    real = [r for r in results if r["ok"] or _real_error(r.get("error", ""))]
+    if real:
+        with open(VERDICT_PATH, "w") as f:
+            json.dump(
+                {
+                    "captured_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    ),
+                    "all_ok": ok_all,
+                    "configs": results,
+                },
+                f,
+                indent=1,
+            )
+    sys.exit(0 if ok_all else 1)
+
+
+if __name__ == "__main__":
+    main()

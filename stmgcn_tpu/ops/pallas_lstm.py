@@ -71,9 +71,15 @@ __all__ = ["fused_lstm", "pallas_lstm_available", "sharded_fused_lstm"]
 #: double-buffering and straight-line temporaries stay inside the
 #: ~16 MB/core scoped VMEM limit. Bigger blocks amortize MXU pipeline
 #: fill across the T*L unrolled small matmuls (measured on v5e, bf16 at
-#: T=12/L=3: 256-row fwd blocks are 1.35x faster end-to-end than 128);
-#: fp32 blocks are half-size because the same byte budget holds half the
-#: rows (256-row fp32 fwd blocks overflow scoped VMEM by ~11 MB). The
+#: T=12/L=3 with the round-2 UNPACKED kernel: 256-row fwd blocks were
+#: 1.35x faster end-to-end than 128). Round-5 recalibration from real
+#: Mosaic AOT evidence (bench_stderr.log, 2026-07-29): the PACKED
+#: kernel under vmapped M=3 branches overflows scoped VMEM at the old
+#: bases — fp32 fwd at 128 rows allocates 18.04 MB vs the 16 MB limit
+#: (the K=2H operand concat + wider wxh block cost ~2 MB the unpacked
+#: form didn't carry) — so both bases are halved for headroom
+#: (~9 MB at the same point); ``benchmarks/pallas_block_sweep.py``
+#: re-raises them per-point on a live chip if the budget allows. The
 #: backward kernel carries ~2.5x the forward's live state (residual
 #: reads + dxp + recompute temporaries), so it takes half the forward's
 #: rows.
@@ -88,13 +94,14 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
 
     Every VMEM-resident term scales as ``rows * T * (5 + 2L) * H``
     (``xp``+``out`` blocks plus the two ``(T, L, rows, H)`` residual
-    blocks), so the row count derives from the measured-good calibration
-    point (T=12, L=3: 256 bf16 / 128 fp32; 512 bf16 and 256 fp32
-    overflow — v5e) by inverse scaling. Longer sequences (the T=24
-    longhorizon preset) automatically take proportionally narrower
-    blocks instead of overflowing scoped VMEM. Rows round down to a
-    power of two and floor at the dtype's sublane tile (16 bf16 /
-    8 fp32).
+    blocks), so the row count derives from the calibration point
+    (T=12, L=3: 128 bf16 / 64 fp32 — half the round-2 unpacked-kernel
+    values, after real Mosaic AOT showed the packed kernel at the old
+    fp32-128 point allocating 18.04 MB vs the 16 MB scoped limit) by
+    inverse scaling. Longer sequences (the T=24 longhorizon preset)
+    automatically take proportionally narrower blocks instead of
+    overflowing scoped VMEM. Rows round down to a power of two and
+    floor at the dtype's sublane tile (16 bf16 / 8 fp32).
 
     Invariant: ``fwd_rows % bwd_rows == 0``. The backward pass re-tiles
     the forward-padded residuals (``hseq``/``cseq`` rows padded to
@@ -103,7 +110,7 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
     """
     import os
 
-    base_fwd = 256 if itemsize <= 2 else 128
+    base_fwd = 128 if itemsize <= 2 else 64
     min_rows = 16 if itemsize <= 2 else 8
     scale = (12 * (5 + 2 * 3)) / (T * (5 + 2 * L))
     fwd_rows = base_fwd

@@ -1,0 +1,45 @@
+"""JAX-aware static analysis: AST lint + jaxpr contract checks.
+
+Two complementary passes over the codebase, both runnable as
+``stmgcn lint`` (see :mod:`stmgcn_tpu.analysis.cli`) and asserted clean
+by tier-1 (``tests/test_analysis.py``):
+
+- **Pass 1 — AST lint** (:mod:`.lint`): a visitor-based linter with
+  repo-specific rules — version-fragile JAX imports (the compat table in
+  :mod:`.rules`; the ``shard_map`` move that killed six test modules at
+  collection is the canonical case), host-sync calls inside jit-reachable
+  functions, Python control flow on traced values, ``time.time()`` spans
+  around device dispatch without a readback fence (the
+  :mod:`stmgcn_tpu.utils.profiling` lesson: on the tunneled axon backend
+  an unfenced span times *dispatch*, not compute), and train-step
+  ``jax.jit`` calls missing ``donate_argnums``.
+- **Pass 2 — contract checks** (:mod:`.jaxpr_check`,
+  :mod:`.sharding_check`): abstractly trace the smoke-preset step
+  functions on CPU and assert jaxpr invariants (no silent fp64
+  promotions, no weak-type outputs that would recompile step 2, a
+  primitive-count budget guarding against fusion-breaking regressions),
+  plus static validation of every ``PartitionSpec`` literal against the
+  mesh axis names and the placement rank table.
+
+Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
+``# stmgcn: ignore``) on the offending line.
+"""
+
+from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
+from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
+from stmgcn_tpu.analysis.report import Finding, render_json, render_text
+from stmgcn_tpu.analysis.rules import RULES, Rule
+from stmgcn_tpu.analysis.sharding_check import check_partition_specs
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "Rule",
+    "check_partition_specs",
+    "check_step_contracts",
+    "lint_package",
+    "lint_paths",
+    "lint_source",
+    "render_json",
+    "render_text",
+]

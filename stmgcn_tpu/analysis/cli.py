@@ -1,0 +1,89 @@
+"""``stmgcn lint``: run both analysis passes and gate on errors.
+
+Usage::
+
+    stmgcn lint                      # lint the shipped package + contracts
+    stmgcn lint path/to/code ...     # lint specific files/dirs (AST only)
+    stmgcn lint --format json        # machine-readable report (CI)
+    stmgcn lint --no-contracts       # AST pass only (no JAX import/trace)
+    stmgcn lint --list-rules         # rule table
+
+Exit code 1 when any *error*-severity finding survives suppression;
+warnings are reported but do not gate. The contract pass (jaxpr +
+sharding) runs only for the default whole-package target — explicit path
+arguments mean "lint this code", which contracts don't apply to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+__all__ = ["build_lint_parser", "main"]
+
+
+def build_lint_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="stmgcn lint",
+        description="JAX-aware static analysis: AST lint + jaxpr/sharding "
+        "contract checks (stmgcn_tpu.analysis)",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the installed "
+                        "stmgcn_tpu package, plus contract checks)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--no-contracts", action="store_true",
+                   help="skip the jaxpr/sharding contract pass (pure-AST "
+                        "mode: fast, no JAX initialization)")
+    p.add_argument("--preset", default="smoke",
+                   help="config preset the contract pass traces (default: "
+                        "smoke)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_lint_parser().parse_args(argv)
+
+    from stmgcn_tpu.analysis.rules import RULES
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule in RULES.values():
+            print(f"{rule.id:<{width}}  {rule.severity:<7}  {rule.summary}")
+        return 0
+
+    from stmgcn_tpu.analysis.lint import lint_package, lint_paths
+    from stmgcn_tpu.analysis.report import render_json, render_text
+
+    if args.paths:
+        findings = lint_paths(args.paths)
+        run_contracts = False
+    else:
+        findings = lint_package()
+        run_contracts = not args.no_contracts
+
+    if run_contracts:
+        # force CPU *before* the contract pass initializes the backend —
+        # lint must never queue on (or wake) an accelerator
+        from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
+        from stmgcn_tpu.analysis.sharding_check import check_partition_specs
+        from stmgcn_tpu.utils.platform import force_host_platform
+
+        force_host_platform("cpu")
+        findings.extend(check_partition_specs())
+        findings.extend(check_step_contracts(args.preset))
+    elif not args.paths:
+        from stmgcn_tpu.analysis.sharding_check import check_partition_specs
+
+        findings.extend(check_partition_specs())
+
+    out = render_json(findings) if args.format == "json" else render_text(findings)
+    print(out)
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

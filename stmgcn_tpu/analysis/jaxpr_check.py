@@ -1,0 +1,167 @@
+"""Pass 2a: jaxpr contract checks on the step functions.
+
+Abstractly traces the smoke-preset train/eval steps on the host
+(``jax.eval_shape`` for parameter shapes, ``jax.make_jaxpr`` for the step
+bodies — no FLOPs execute, so this runs in seconds on CPU) and asserts
+invariants that only show up at trace level:
+
+- **fp64-promotion** — no ``convert_element_type`` to float64 and no
+  float64 aval anywhere in the jaxpr. TPUs have no fp64 MXU path; a
+  stray numpy float64 constant silently doubles memory traffic and, on
+  hardware, falls off the fast path entirely.
+- **weak-type-output** — no weak-typed output aval where the inputs were
+  strongly typed. A weak output fed back as the next step's input (the
+  params/opt-state loop) re-traces and recompiles on step 2 — the classic
+  "first two steps compile" hazard.
+- **primitive-budget** — the recursive primitive count of each step stays
+  under a recorded budget. Fusion breakage (a rematerialized subgraph, an
+  accidentally unrolled scan, a transpose that stopped fusing) shows up
+  as op-count growth long before it shows up in a profile; the budget
+  makes it a test failure. Rebaseline ``PRIMITIVE_BUDGETS`` deliberately
+  when a real feature moves the count.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["PRIMITIVE_BUDGETS", "check_step_contracts", "count_primitives"]
+
+#: measured on jax 0.4.37 CPU (train 430 / eval 94 primitives for the
+#: smoke preset) with ~2x headroom for legitimate feature growth — the
+#: guard is against order-of-magnitude fusion/unroll regressions (an
+#: accidentally unrolled scan multiplies the count by seq_len), not
+#: single-op drift. Rebaseline alongside the feature that moves it.
+PRIMITIVE_BUDGETS = {"train_step": 900, "eval_step": 250}
+
+
+def _sub_jaxprs(params: dict):
+    try:  # the forward-portable home (jax >= 0.4.33; jax.core goes in 0.6)
+        from jax.extend.core import ClosedJaxpr, Jaxpr
+    except ImportError:
+        from jax.core import ClosedJaxpr, Jaxpr
+
+    for v in params.values():
+        if isinstance(v, (ClosedJaxpr, Jaxpr)):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                if isinstance(item, (ClosedJaxpr, Jaxpr)):
+                    yield item
+
+
+def _walk_eqns(jaxpr):
+    """Yield every eqn, recursing into call/control-flow sub-jaxprs."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in inner.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from _walk_eqns(sub)
+
+
+def count_primitives(jaxpr) -> int:
+    return sum(1 for _ in _walk_eqns(jaxpr))
+
+
+def _check_one(name: str, closed, n_strong_inputs: bool, budget: Optional[int]):
+    findings: List[Finding] = []
+    path = f"<contract:{name}>"
+
+    def emit(rule: str, message: str) -> None:
+        findings.append(
+            Finding(rule=rule, path=path, line=0, message=message,
+                    severity=RULES[rule].severity)
+        )
+
+    f64 = np.dtype(np.float64)
+    for eqn in _walk_eqns(closed):
+        if (
+            eqn.primitive.name == "convert_element_type"
+            and np.dtype(eqn.params.get("new_dtype", np.float32)) == f64
+        ):
+            emit(
+                "fp64-promotion",
+                f"{name}: convert_element_type to float64 "
+                f"(source: {eqn.source_info.traceback})"[:500],
+            )
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and getattr(aval, "dtype", None) == f64:
+                emit(
+                    "fp64-promotion",
+                    f"{name}: {eqn.primitive.name} produces a float64 value",
+                )
+
+    if n_strong_inputs:
+        for i, aval in enumerate(closed.out_avals):
+            if getattr(aval, "weak_type", False):
+                emit(
+                    "weak-type-output",
+                    f"{name}: output {i} is weak-typed "
+                    f"({aval.str_short()}) with strongly-typed inputs — "
+                    "feeding it back recompiles the step",
+                )
+
+    if budget is not None:
+        n = count_primitives(closed)
+        if n > budget:
+            emit(
+                "primitive-budget",
+                f"{name}: {n} primitives > budget {budget} — fusion/unroll "
+                "regression, or rebaseline PRIMITIVE_BUDGETS with the "
+                "feature that moved it",
+            )
+    return findings
+
+
+def check_step_contracts(preset_name: str = "smoke") -> List[Finding]:
+    """Trace the preset's train/eval steps abstractly and check contracts.
+
+    CPU-only and concrete-data-free past dataset synthesis: parameter
+    shapes come from ``jax.eval_shape`` over the jitted init, the step
+    jaxprs from ``jax.make_jaxpr`` over ``ShapeDtypeStruct`` inputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from stmgcn_tpu.config import preset
+    from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
+    from stmgcn_tpu.train import make_optimizer, make_step_fns
+
+    cfg = preset(preset_name)
+    dataset = build_dataset(cfg)
+    supports, modes = route_supports(cfg, dataset)
+    model = build_model(cfg, dataset.n_feats, modes)
+    fns = make_step_fns(
+        model,
+        make_optimizer(cfg.train.lr, cfg.train.weight_decay),
+        loss=cfg.train.loss,
+    )
+
+    b = cfg.train.batch_size
+    t = cfg.data.serial_len + cfg.data.daily_len + cfg.data.weekly_len
+    n, c = dataset.n_nodes, dataset.n_feats
+    f32 = jnp.float32
+    sup = jax.ShapeDtypeStruct(np.shape(supports), f32)
+    x = jax.ShapeDtypeStruct((b, t, n, c), f32)
+    y = jax.ShapeDtypeStruct((b, n, c), f32)
+    mask = jax.ShapeDtypeStruct((b,), f32)
+
+    params, opt_state = jax.eval_shape(fns.init, jax.random.PRNGKey(0), sup, x)
+    train_jaxpr = jax.make_jaxpr(fns.train_step)(
+        params, opt_state, sup, x, y, mask
+    )
+    eval_jaxpr = jax.make_jaxpr(fns.eval_step)(params, sup, x, y, mask)
+
+    findings = _check_one(
+        "train_step", train_jaxpr, True, PRIMITIVE_BUDGETS["train_step"]
+    )
+    findings += _check_one(
+        "eval_step", eval_jaxpr, True, PRIMITIVE_BUDGETS["eval_step"]
+    )
+    return findings

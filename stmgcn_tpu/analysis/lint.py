@@ -1,0 +1,417 @@
+"""Pass 1: the AST linter.
+
+Per-module analysis in two sweeps. Sweep one builds a module index —
+import aliases (so ``jnp.any`` resolves to ``jax.numpy.any`` whatever the
+alias), every function/method definition, and the *jit-reachability* seed
+set: functions wrapped by a tracing transform (``jax.jit`` / ``shard_map``
+/ ``vmap`` / ``grad`` / ``lax.scan`` bodies, as decorators or call
+arguments) plus methods of ``flax`` ``nn.Module`` subclasses (flax applies
+run under trace). Reachability then propagates through same-module calls
+by name. Sweep two walks each function and emits findings; rules that
+only make sense under trace (``host-sync-in-jit``, ``traced-control-flow``)
+fire only inside reachable functions, which is what keeps host-side
+pre-processing (support building, metrics, checkpointing) out of scope.
+
+Reachability is deliberately per-module: cross-module call graphs over a
+dynamically-dispatched codebase produce exactly the false positives that
+make a linter get turned off. The contract pass (:mod:`.jaxpr_check`)
+covers the cross-module hot path by tracing it for real.
+
+Suppression: ``# stmgcn: ignore[rule-id]`` (or bare ``# stmgcn: ignore``)
+on the finding's line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import JAX_COMPAT_ATTRS, JAX_COMPAT_IMPORTS, RULES
+
+__all__ = ["lint_package", "lint_paths", "lint_source"]
+
+#: transforms whose function argument executes under a JAX trace
+_TRACER_WRAPPERS = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "shard_map",
+    "checkify", "remat", "checkpoint", "scan", "while_loop", "cond",
+    "fori_loop", "switch", "associative_scan", "custom_vjp", "custom_jvp",
+}
+
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
+
+_SUPPRESS_RE = re.compile(r"#\s*stmgcn:\s*ignore(?:\[([\w\-, ]+)\])?")
+
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """``line -> suppressed rule ids`` (``None`` = every rule)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = (
+                {r.strip() for r in m.group(1).split(",")} if m.group(1) else None
+            )
+    return out
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Sweep one: aliases, function defs, jit-root seeds, call edges."""
+
+    def __init__(self):
+        self.aliases: Dict[str, str] = {}  # local name -> dotted module
+        self.funcs: Dict[str, ast.AST] = {}  # simple name -> def node
+        self.calls: Dict[str, Set[str]] = {}  # caller name -> callee names
+        self.roots: Set[str] = set()
+        self._stack: List[str] = []
+        self._class_is_flax: List[bool] = []
+
+    # -- imports ---------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.aliases[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            if node.module:
+                self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        self.generic_visit(node)
+
+    # -- resolution helpers ----------------------------------------------
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolve an attribute chain to a dotted path through the alias
+        map (``jnp.any`` -> ``jax.numpy.any``); None for non-name roots."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+    # -- defs --------------------------------------------------------------
+    def _handle_func(self, node) -> None:
+        name = node.name
+        self.funcs.setdefault(name, node)
+        self.calls.setdefault(name, set())
+        if self._class_is_flax and self._class_is_flax[-1]:
+            self.roots.add(name)
+        for dec in node.decorator_list:
+            for cand in self._wrapper_names(dec):
+                self.roots.add(name)
+                break
+        self._stack.append(name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _handle_func
+    visit_AsyncFunctionDef = _handle_func
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        is_flax = any(
+            (self.dotted(b) or "").split(".")[-1] == "Module"
+            for b in node.bases
+        )
+        self._class_is_flax.append(is_flax)
+        self.generic_visit(node)
+        self._class_is_flax.pop()
+
+    def _wrapper_names(self, node: ast.AST) -> List[str]:
+        """Tracer-wrapper hits inside a decorator / call-func expression."""
+        hits: List[str] = []
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                d = self.dotted(sub)
+                if d and d.split(".")[-1] in _TRACER_WRAPPERS:
+                    hits.append(d)
+        return hits
+
+    # -- call edges + root seeding ----------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = None
+        if isinstance(node.func, ast.Name):
+            callee = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            callee = node.func.attr  # self.foo() / mod.foo(): match by name
+        if self._stack and callee:
+            self.calls[self._stack[-1]].add(callee)
+        # a local function handed to a tracing transform becomes a root
+        d = self.dotted(node.func)
+        if d and d.split(".")[-1] in _TRACER_WRAPPERS:
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in self.funcs:
+                        self.roots.add(sub.id)
+        self.generic_visit(node)
+
+    def reachable(self) -> Set[str]:
+        seen = set(self.roots & set(self.funcs))
+        frontier = list(seen)
+        while frontier:
+            fn = frontier.pop()
+            for callee in self.calls.get(fn, ()):
+                if callee in self.funcs and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+
+class _Linter:
+    def __init__(self, tree: ast.Module, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.index = _ModuleIndex()
+        self.index.visit(tree)
+        # late seeding: functions defined after the call that jits them
+        self.reachable = self.index.reachable()
+        self.tree = tree
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", -1) + 1,
+                message=message,
+                severity=RULES[rule].severity,
+            )
+        )
+
+    def run(self) -> List[Finding]:
+        self._check_imports()
+        for name, fn in self.index.funcs.items():
+            self._check_timing_span(fn)
+            if name in self.reachable:
+                self._check_traced_body(fn)
+        self._check_compat_attrs()
+        self._check_donate()
+        return self.findings
+
+    # -- jax-compat-import -------------------------------------------------
+    def _check_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    why = JAX_COMPAT_IMPORTS.get(
+                        (node.module, a.name)
+                    ) or JAX_COMPAT_IMPORTS.get((node.module, "*"))
+                    if why:
+                        self._emit(
+                            "jax-compat-import", node,
+                            f"`from {node.module} import {a.name}`: {why}",
+                        )
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    mod = a.name
+                    why = JAX_COMPAT_IMPORTS.get((mod, "*"))
+                    if why is None and "." in mod:
+                        parent, _, leaf = mod.rpartition(".")
+                        why = JAX_COMPAT_IMPORTS.get((parent, leaf))
+                    if why:
+                        self._emit(
+                            "jax-compat-import", node, f"`import {mod}`: {why}"
+                        )
+
+    def _check_compat_attrs(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                d = self.index.dotted(node.func)
+                if d in JAX_COMPAT_ATTRS:
+                    self._emit(
+                        "jax-compat-import", node,
+                        f"`{d}(...)`: {JAX_COMPAT_ATTRS[d]}",
+                    )
+
+    # -- host-sync-in-jit / traced-control-flow ---------------------------
+    def _is_host_sync(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args:
+                return ".item() readback"
+            if f.attr == "block_until_ready":
+                return ".block_until_ready() sync"
+            d = self.index.dotted(f)
+            if d in ("jax.device_get", "jax.block_until_ready"):
+                return f"{d} sync"
+            if d is not None and d.startswith("numpy.") and f.attr == "asarray":
+                return "np.asarray device->host copy"
+        elif isinstance(f, ast.Name) and f.id == "float":
+            if len(node.args) == 1 and not isinstance(node.args[0], ast.Constant):
+                return "float() readback of a computed value"
+        return None
+
+    def _check_traced_body(self, fn) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                why = self._is_host_sync(node)
+                if why:
+                    self._emit(
+                        "host-sync-in-jit", node,
+                        f"{why} inside jit-reachable `{fn.name}`",
+                    )
+            elif isinstance(node, (ast.If, ast.While)):
+                traced = self._traced_test(node.test)
+                if traced:
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    self._emit(
+                        "traced-control-flow", node,
+                        f"Python `{kw}` on traced value ({traced}) in "
+                        f"jit-reachable `{fn.name}` — use jnp.where / "
+                        "lax.cond / lax.while_loop",
+                    )
+
+    def _traced_test(self, test: ast.AST) -> Optional[str]:
+        """A test expression that evaluates a traced array to a bool."""
+        for sub in ast.walk(test):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = self.index.dotted(sub.func)
+            if d and (d.startswith("jax.numpy.") or d.startswith("jax.lax.")):
+                return d
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("any", "all")
+                and not (d and d.startswith("numpy."))
+            ):
+                return f".{sub.func.attr}()"
+        return None
+
+    # -- unfenced-timing ---------------------------------------------------
+    def _check_timing_span(self, fn) -> None:
+        starts: Set[str] = set()
+        closing: List[ast.AST] = []
+        dispatch = fence = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                d = self.index.dotted(node.value.func)
+                if d in _TIME_CALLS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            starts.add(t.id)
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if (
+                    isinstance(node.left, ast.Call)
+                    and self.index.dotted(node.left.func) in _TIME_CALLS
+                    and isinstance(node.right, ast.Name)
+                    and node.right.id in starts
+                ):
+                    closing.append(node)
+            if isinstance(node, ast.Call):
+                if self._is_host_sync(node) is not None:
+                    fence = True
+                f = node.func
+                name = (
+                    f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute) else ""
+                )
+                if name == "fence":
+                    fence = True
+                if (
+                    name in ("apply", "step")
+                    or name.endswith("_step")
+                    or name in self.reachable
+                ):
+                    dispatch = True
+        if closing and dispatch and not fence:
+            self._emit(
+                "unfenced-timing", closing[0],
+                f"timing span in `{fn.name}` brackets device dispatch with "
+                "no readback fence — times dispatch, not compute; fence the "
+                "result (stmgcn_tpu.utils.profiling.fence) or use "
+                "time_chained",
+            )
+
+    # -- missing-donate ----------------------------------------------------
+    _DONATE_MSG = (
+        "jax.jit of a train step without donate_argnums — params/opt-state "
+        "buffers are copied, not reused, every step"
+    )
+
+    def _is_jit(self, node: ast.AST) -> bool:
+        d = self.index.dotted(node)
+        return bool(d) and d.split(".")[-1] in ("jit", "pjit")
+
+    def _check_donate(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and self._is_jit(node.func):
+                if not node.args:
+                    continue
+                names: List[str] = [
+                    sub.id
+                    for sub in ast.walk(node.args[0])
+                    if isinstance(sub, ast.Name)
+                ]
+                if not any("train_step" in n for n in names):
+                    continue
+                kwargs = {kw.arg for kw in node.keywords}
+                if not kwargs & {"donate_argnums", "donate_argnames"}:
+                    self._emit("missing-donate", node, self._DONATE_MSG)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if "train_step" not in node.name:
+                    continue
+                for dec in node.decorator_list:
+                    if self._is_jit(dec):
+                        # bare @jax.jit cannot carry donate_argnums at all
+                        self._emit("missing-donate", dec, self._DONATE_MSG)
+                    elif isinstance(dec, ast.Call) and any(
+                        self._is_jit(a) for a in [dec.func] + list(dec.args)
+                    ):
+                        kwargs = {kw.arg for kw in dec.keywords}
+                        if not kwargs & {"donate_argnums", "donate_argnames"}:
+                            self._emit("missing-donate", dec, self._DONATE_MSG)
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one module's source text; returns surviving findings."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="jax-compat-import", path=path, line=e.lineno or 0,
+                message=f"unparseable module: {e.msg}", severity="error",
+            )
+        ]
+    findings = _Linter(tree, path).run()
+    suppress = _suppressions(source)
+    out = []
+    for f in findings:
+        rules = suppress.get(f.line, ...)
+        if rules is ... or (rules is not None and f.rule not in rules):
+            out.append(f)
+    return out
+
+
+def lint_paths(paths: Iterable) -> List[Finding]:
+    """Lint ``.py`` files / directory trees; paths become repo-relative."""
+    findings: List[Finding] = []
+    cwd = os.getcwd()
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    for f in files:
+        rel = os.path.relpath(f, cwd)
+        rel = f.as_posix() if rel.startswith("..") else Path(rel).as_posix()
+        findings.extend(lint_source(f.read_text(), rel))
+    return findings
+
+
+def lint_package(root: Optional[str] = None) -> List[Finding]:
+    """Lint the shipped ``stmgcn_tpu`` package (the tier-1 contract)."""
+    if root is None:
+        import stmgcn_tpu
+
+        root = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
+    return lint_paths([root])

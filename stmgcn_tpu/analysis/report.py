@@ -1,0 +1,65 @@
+"""Findings and report rendering for the analysis passes.
+
+A :class:`Finding` is one rule violation at one source location; both
+passes produce lists of them so the CLI, the tier-1 test, and any CI
+gate consume one shape. ``render_json`` is the machine-readable contract
+(``stmgcn lint --format json``): a stable top-level object with the rule
+table version, counts, and per-finding records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, List
+
+__all__ = ["Finding", "render_json", "render_text"]
+
+#: bumped when the JSON report shape or rule ids change incompatibly
+REPORT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``path`` is repo-relative where possible; ``line``/``col`` are
+    1-based (col 0 for whole-file findings such as contract failures).
+    """
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    col: int = 0
+    severity: str = "error"  # "error" gates; "warning" reports only
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+def render_text(findings: Iterable[Finding]) -> str:
+    """Human-readable one-line-per-finding report, sorted by location."""
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    if not ordered:
+        return "stmgcn lint: clean"
+    lines: List[str] = [str(f) for f in ordered]
+    n_err = sum(1 for f in ordered if f.severity == "error")
+    n_warn = len(ordered) - n_err
+    lines.append(f"stmgcn lint: {n_err} error(s), {n_warn} warning(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: Iterable[Finding]) -> str:
+    """Machine-readable report (the CI contract)."""
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    payload = {
+        "version": REPORT_VERSION,
+        "errors": sum(1 for f in ordered if f.severity == "error"),
+        "warnings": sum(1 for f in ordered if f.severity != "error"),
+        "findings": [f.to_dict() for f in ordered],
+    }
+    return json.dumps(payload, indent=2)

@@ -1,0 +1,142 @@
+"""Rule registry and the JAX symbol-compatibility table.
+
+Every finding carries one of these rule ids; the tier-1 test and the
+``stmgcn lint`` CLI treat ``error``-severity rules as gating. The compat
+table is the machine-readable form of the supported-version contract
+(``jax>=0.4.30,<0.6`` in pyproject.toml): symbols that moved, appeared,
+or disappeared inside that range must be routed through
+:mod:`stmgcn_tpu.utils.platform` so one shim owns the version split —
+``from jax import shard_map`` at module scope is precisely the mistake
+that killed six test modules at collection on this image's jax 0.4.37.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+__all__ = ["JAX_COMPAT_ATTRS", "JAX_COMPAT_IMPORTS", "RULES", "Rule"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str  # "error" | "warning"
+    summary: str
+
+
+_ALL_RULES = [
+    # -- pass 1: AST lint ------------------------------------------------
+    Rule(
+        "jax-compat-import",
+        "error",
+        "import of a JAX symbol that moved/appeared/disappeared within the "
+        "supported version range (jax>=0.4.30,<0.6); route it through "
+        "stmgcn_tpu.utils.platform",
+    ),
+    Rule(
+        "host-sync-in-jit",
+        "error",
+        "host-synchronizing call (.item()/float()/np.asarray/jax.device_get/"
+        "block_until_ready) inside a function reachable from jitted code — "
+        "a hidden device->host readback in the hot path",
+    ),
+    Rule(
+        "traced-control-flow",
+        "error",
+        "Python if/while on a traced value inside a jit-reachable function "
+        "— fails to trace, or silently specializes on one branch",
+    ),
+    Rule(
+        "unfenced-timing",
+        "warning",
+        "time.time()/perf_counter() span around device dispatch with no "
+        "readback fence — on the tunneled axon backend this times dispatch, "
+        "not compute (see stmgcn_tpu.utils.profiling)",
+    ),
+    Rule(
+        "missing-donate",
+        "warning",
+        "jax.jit of a train-step-like function without donate_argnums — "
+        "params/opt-state buffers are copied instead of reused every step",
+    ),
+    # -- pass 2: jaxpr / sharding contracts ------------------------------
+    Rule(
+        "fp64-promotion",
+        "error",
+        "step jaxpr contains a convert_element_type to float64 — a silent "
+        "2x memory/bandwidth promotion (TPUs have no fp64 MXU path)",
+    ),
+    Rule(
+        "weak-type-output",
+        "error",
+        "step output aval is weak-typed where its input was not — the "
+        "second call recompiles against the strengthened type",
+    ),
+    Rule(
+        "primitive-budget",
+        "error",
+        "step jaxpr primitive count exceeds the recorded budget — a fusion "
+        "or op-count regression (rebaseline deliberately if intended)",
+    ),
+    Rule(
+        "partition-axis-name",
+        "error",
+        "PartitionSpec names a mesh axis that no mesh in this repo defines "
+        "(known axes: dp, region, branch)",
+    ),
+    Rule(
+        "partition-rank",
+        "error",
+        "PartitionSpec rank exceeds the documented operand rank for its "
+        "array kind (placement table)",
+    ),
+]
+
+RULES: Dict[str, Rule] = {r.id: r for r in _ALL_RULES}
+
+#: ``(module, symbol) -> why`` — ``from module import symbol`` is flagged.
+#: ``symbol`` of ``"*"`` flags any import from that module.
+JAX_COMPAT_IMPORTS: Dict[Tuple[str, str], str] = {
+    ("jax", "shard_map"): (
+        "jax.shard_map only exists from 0.5.x; use "
+        "stmgcn_tpu.utils.platform.shard_map (handles check_vma/check_rep)"
+    ),
+    ("jax.experimental.shard_map", "*"): (
+        "moves to jax.shard_map in 0.5.x; use "
+        "stmgcn_tpu.utils.platform.shard_map"
+    ),
+    ("jax", "linear_util"): "moved to jax.extend.linear_util in 0.4.x",
+    ("jax.experimental", "maps"): "removed in 0.4.x (xmap retired)",
+    ("jax.experimental.maps", "*"): "removed in 0.4.x (xmap retired)",
+    ("jax.experimental", "host_callback"): (
+        "removed; use jax.experimental.io_callback / jax.debug.callback"
+    ),
+    ("jax.experimental.host_callback", "*"): (
+        "removed; use jax.experimental.io_callback / jax.debug.callback"
+    ),
+    ("jax", "abstract_arrays"): "removed in 0.4.x; use jax.core avals",
+    ("jax.experimental", "global_device_array"): "removed; use jax.Array",
+    ("jax.experimental.global_device_array", "*"): "removed; use jax.Array",
+    ("jax.interpreters", "xla"): (
+        "gutted across 0.4.x; use jax.extend / public APIs"
+    ),
+}
+
+#: dotted attribute chains (rooted at the ``jax`` module) that are
+#: version-fragile when *called*, with the portable replacement.
+JAX_COMPAT_ATTRS: Dict[str, str] = {
+    "jax.lax.axis_size": (
+        "only exists from 0.5.x; use stmgcn_tpu.utils.platform.axis_size"
+    ),
+    "jax.shard_map": (
+        "only exists from 0.5.x; use stmgcn_tpu.utils.platform.shard_map"
+    ),
+    "jax.tree_map": "removed in 0.6; use jax.tree.map",
+    "jax.tree_multimap": "removed long ago; use jax.tree.map",
+    "jax.treedef_is_leaf": "moved to jax.tree_util",
+    "jax.experimental.shard_map.shard_map": (
+        "moves to jax.shard_map in 0.5.x; use "
+        "stmgcn_tpu.utils.platform.shard_map"
+    ),
+}

@@ -1,0 +1,141 @@
+"""Pass 2b: static PartitionSpec validation.
+
+Two checks over the sharding layer:
+
+- **partition-axis-name** — every *string-literal* axis name inside a
+  ``PartitionSpec(...)`` / ``P(...)`` call in the package must be one of
+  the mesh axes this repo ever constructs (``dp``, ``region``, ``branch``
+  — :func:`stmgcn_tpu.parallel.mesh.build_mesh`). A typo'd axis name
+  (``"regoin"``) passes Python, passes single-device tests (specs are
+  inert off-mesh), and only explodes at ``device_put`` on real hardware.
+  Names held in variables are out of static reach and are skipped — the
+  placement runtime raises on those.
+- **partition-rank** — the :class:`~stmgcn_tpu.parallel.placement
+  .MeshPlacement` table's specs must fit the documented operand ranks
+  (a spec longer than its operand's ndim raises at placement time, on
+  device, at full scale).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from pathlib import Path
+from typing import List, Optional
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["MESH_AXES", "check_partition_specs"]
+
+#: the only axis names any mesh in this repo constructs
+#: (stmgcn_tpu/parallel/mesh.py: build_mesh)
+MESH_AXES = frozenset({"dp", "region", "branch"})
+
+#: array-kind -> max operand rank for the MeshPlacement.SPECS table
+#: (module docstring of stmgcn_tpu/parallel/placement.py)
+_KIND_RANKS = {"supports": 4, "x": 4, "y": 4, "mask": 2, "state": 0}
+
+
+def _spec_aliases(tree: ast.Module) -> set:
+    """Local names bound to jax.sharding.PartitionSpec in this module."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax.sharding", "jax.experimental.pjit", "jax.interpreters.pxla"
+        ):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _literal_axes(arg: ast.AST):
+    """String-literal axis names in one P() argument (handles tuples)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        yield arg.value, arg
+    elif isinstance(arg, (ast.Tuple, ast.List)):
+        for elt in arg.elts:
+            yield from _literal_axes(elt)
+
+
+def _check_file(path: Path, rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = ast.parse(path.read_text())
+    aliases = _spec_aliases(tree)
+    if not aliases:
+        return findings
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in aliases
+        ):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for name, src in _literal_axes(arg):
+                if name not in MESH_AXES:
+                    findings.append(
+                        Finding(
+                            rule="partition-axis-name",
+                            path=rel,
+                            line=src.lineno,
+                            col=src.col_offset + 1,
+                            message=(
+                                f"PartitionSpec axis {name!r} is not a mesh "
+                                f"axis this repo builds ({sorted(MESH_AXES)})"
+                            ),
+                            severity=RULES["partition-axis-name"].severity,
+                        )
+                    )
+    return findings
+
+
+def check_partition_specs(root: Optional[str] = None) -> List[Finding]:
+    """Run both sharding checks; ``root`` defaults to the package dir."""
+    if root is None:
+        import stmgcn_tpu
+
+        root = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
+    findings: List[Finding] = []
+    cwd = os.getcwd()
+    for f in sorted(Path(root).rglob("*.py")):
+        rel = os.path.relpath(f, cwd)
+        rel = f.as_posix() if rel.startswith("..") else Path(rel).as_posix()
+        findings.extend(_check_file(f, rel))
+
+    # runtime rank validation of the placement table (no mesh needed:
+    # PartitionSpec length is static)
+    from stmgcn_tpu.parallel.placement import MeshPlacement
+
+    for kind, spec in MeshPlacement.SPECS.items():
+        max_rank = _KIND_RANKS.get(kind)
+        if max_rank is not None and len(spec) > max_rank:
+            findings.append(
+                Finding(
+                    rule="partition-rank",
+                    path="stmgcn_tpu/parallel/placement.py",
+                    line=0,
+                    message=(
+                        f"SPECS[{kind!r}] has rank {len(spec)} > documented "
+                        f"operand rank {max_rank}"
+                    ),
+                    severity=RULES["partition-rank"].severity,
+                )
+            )
+        for ax in spec:
+            for name in (ax if isinstance(ax, tuple) else (ax,)):
+                if name is not None and name not in MESH_AXES:
+                    findings.append(
+                        Finding(
+                            rule="partition-axis-name",
+                            path="stmgcn_tpu/parallel/placement.py",
+                            line=0,
+                            message=(
+                                f"SPECS[{kind!r}] names unknown mesh axis "
+                                f"{name!r}"
+                            ),
+                            severity=RULES["partition-axis-name"].severity,
+                        )
+                    )
+    return findings

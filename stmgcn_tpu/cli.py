@@ -12,6 +12,7 @@ Usage::
     python -m stmgcn_tpu.cli --preset default --data ./data/data_dict.npz \
         -date 0101 0630 0701 0731 -cpt 3 1 1
     python -m stmgcn_tpu.cli --preset default --test-only --out-dir output
+    python -m stmgcn_tpu.cli lint --format json   # static analysis gate
 """
 
 from __future__ import annotations
@@ -218,6 +219,14 @@ def config_from_args(args) -> "ExperimentConfig":
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "lint":
+        # static-analysis subcommand: no training imports, no JAX backend
+        # unless the contract pass runs (and then CPU-pinned)
+        from stmgcn_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.print_config:

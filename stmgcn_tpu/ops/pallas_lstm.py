@@ -471,8 +471,9 @@ def sharded_fused_lstm(mesh, row_axes: tuple = ("dp", "region")):
     Cached per ``(mesh, row_axes)`` so flax re-traces reuse one
     ``custom_vjp`` instance instead of registering a fresh pair per call.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from stmgcn_tpu.utils.platform import shard_map
 
     axes = tuple(a for a in row_axes if a in mesh.shape)
     if not axes:

@@ -26,10 +26,10 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from stmgcn_tpu.parallel.halo import halo_exchange
+from stmgcn_tpu.utils.platform import shard_map
 
 __all__ = [
     "BandedSpec",
@@ -216,5 +216,10 @@ def sharded_banded_apply(
         mesh=mesh,
         in_specs=(P(axis_name, None, None, None), P(b_ax, axis_name, None)),
         out_specs=P(None, b_ax, axis_name, None),
+        # under the branch-stacked layout (outer vmap with
+        # spmd_axis_name='branch') the replication checker sees mismatched
+        # varying-axes sets on the einsum operands and rejects a correct
+        # program; disable it like sparse.py / pallas_lstm.py do
+        check_vma=False,
     )
     return fn(jnp.asarray(strips), jnp.asarray(x))

@@ -18,6 +18,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from stmgcn_tpu.utils.platform import axis_size
+
 __all__ = ["halo_exchange"]
 
 
@@ -35,7 +37,7 @@ def halo_exchange(x: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
         raise ValueError(f"halo must be positive, got {halo}")
     if x.shape[0] < halo:
         raise ValueError(f"shard has {x.shape[0]} rows < halo {halo}")
-    n_shards = jax.lax.axis_size(axis_name)
+    n_shards = axis_size(axis_name)
     # left halo: shard i receives shard i-1's trailing rows
     from_left = jax.lax.ppermute(
         x[-halo:], axis_name, perm=[(i, i + 1) for i in range(n_shards - 1)]

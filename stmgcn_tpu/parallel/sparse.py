@@ -27,9 +27,9 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from stmgcn_tpu.utils.platform import shard_map
 from stmgcn_tpu.ops.spmm import (
     TILE,
     BlockSparseStack,

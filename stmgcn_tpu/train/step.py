@@ -193,6 +193,9 @@ def make_step_fns(
 
     # init is jitted too: eager flax init dispatches hundreds of tiny ops,
     # which is pathologically slow on remote-tunneled TPU backends.
+    # donate_argnums on every train-step jit is a lint contract
+    # (missing-donate, stmgcn_tpu/analysis): params/opt-state buffers are
+    # reused in place instead of copied each step.
     if checks is None:
         return StepFns(
             init=jax.jit(init),

@@ -3,7 +3,7 @@
 from stmgcn_tpu.utils.comm import collective_stats, step_comm_report
 from stmgcn_tpu.utils.flops import device_peak_flops, mfu, stmgcn_step_flops
 from stmgcn_tpu.utils.hostload import BenchLock, host_load_snapshot
-from stmgcn_tpu.utils.platform import force_host_platform
+from stmgcn_tpu.utils.platform import force_host_platform, shard_map
 from stmgcn_tpu.utils.profiling import (
     StepTimer,
     fence,
@@ -22,6 +22,7 @@ __all__ = [
     "force_host_platform",
     "mfu",
     "region_timesteps_per_sec",
+    "shard_map",
     "step_comm_report",
     "stmgcn_step_flops",
     "time_chained",

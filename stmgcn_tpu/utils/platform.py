@@ -1,4 +1,4 @@
-"""Pin the JAX host platform before backend initialization.
+"""Pin the JAX host platform before backend initialization + JAX compat shims.
 
 The axon TPU plugin in this image **ignores the ``JAX_PLATFORMS`` env
 var** — only the ``jax_platforms`` config flag sticks — and its backend
@@ -9,6 +9,20 @@ through this one helper instead of hand-copying the workaround.
 Must run **before** the JAX backend initializes (any ``jax.devices()`` /
 first op): both ``XLA_FLAGS`` and the platform choice are read once at
 backend init and silently ignored afterwards.
+
+This module is also the single home for symbols that moved between the
+JAX versions the project supports (``jax>=0.4.30,<0.6``, pinned in
+pyproject.toml):
+
+- :func:`shard_map` — ``jax.shard_map`` only exists from 0.5.x; on 0.4.x
+  the public spelling is ``jax.experimental.shard_map.shard_map``, whose
+  replication-check kwarg is ``check_rep`` rather than ``check_vma``.
+- :func:`axis_size` — ``jax.lax.axis_size`` only exists from 0.5.x; on
+  0.4.x the portable spelling is ``lax.psum(1, axis_name)``, which XLA
+  constant-folds to the mesh extent.
+
+Importing these symbols from jax directly anywhere else is a lint error
+(rule ``jax-compat-import`` in :mod:`stmgcn_tpu.analysis`).
 """
 
 from __future__ import annotations
@@ -17,7 +31,41 @@ import os
 import re
 from typing import Optional
 
-__all__ = ["force_host_platform"]
+__all__ = ["axis_size", "force_host_platform", "shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True, **kwargs):
+    """Version-portable ``shard_map`` (new-API spelling, old-API fallback).
+
+    Accepts the modern ``check_vma`` kwarg on every supported JAX; on
+    0.4.x it is forwarded as ``check_rep`` (same meaning, renamed when
+    the varying-mesh-axes checker replaced the replication checker).
+    """
+    import jax
+
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_vma, **kwargs)
+    from jax.experimental.shard_map import shard_map as old  # stmgcn: ignore[jax-compat-import]
+
+    return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,  # stmgcn: ignore[jax-compat-import]
+               check_rep=check_vma, **kwargs)
+
+
+def axis_size(axis_name) -> "int | jax.Array":
+    """Version-portable ``jax.lax.axis_size`` (mesh extent of a named axis).
+
+    Must be called under a binding of ``axis_name`` (inside ``shard_map``
+    / ``pmap``). On 0.4.x jax, falls back to ``lax.psum(1, axis_name)`` —
+    semantically identical and constant-folded by XLA.
+    """
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def force_host_platform(platform: str = "cpu", n_devices: Optional[int] = None) -> None:

@@ -1,0 +1,464 @@
+"""The static-analysis subsystem's own tests (stmgcn_tpu.analysis).
+
+Three layers: (1) every AST rule fires on a known-bad fixture and stays
+quiet on the matching known-good twin; (2) the contract pass flags
+synthetic jaxpr violations and passes the real smoke-preset steps;
+(3) the shipped package is clean — the tier-1 gate that turns every
+future hazard of this class into a test failure instead of a latent TPU
+incident.
+"""
+
+import json
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.analysis import (
+    RULES,
+    check_partition_specs,
+    check_step_contracts,
+    lint_package,
+    lint_source,
+)
+from stmgcn_tpu.analysis.jaxpr_check import _check_one, count_primitives
+from stmgcn_tpu.analysis.report import render_json
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def lint(src):
+    return lint_source(textwrap.dedent(src), "fixture.py")
+
+
+class TestCompatImportRule:
+    def test_from_jax_import_shard_map(self):
+        f = lint("from jax import shard_map\n")
+        assert _rules(f) == {"jax-compat-import"}
+        assert "0.5.x" in f[0].message
+
+    def test_experimental_shard_map(self):
+        f = lint("from jax.experimental.shard_map import shard_map\n")
+        assert _rules(f) == {"jax-compat-import"}
+
+    def test_import_module_form(self):
+        f = lint("import jax.experimental.maps\n")
+        assert _rules(f) == {"jax-compat-import"}
+
+    def test_versioned_attr_call(self):
+        f = lint(
+            """
+            import jax
+
+            def f(x):
+                return jax.lax.axis_size("region") * x
+            """
+        )
+        assert _rules(f) == {"jax-compat-import"}
+
+    def test_aliased_attr_call_resolves(self):
+        # `import jax as j; j.tree_map(...)` must still resolve
+        f = lint("import jax as j\nout = j.tree_map(abs, {})\n")
+        assert _rules(f) == {"jax-compat-import"}
+
+    def test_shim_import_is_clean(self):
+        f = lint("from stmgcn_tpu.utils.platform import shard_map\n")
+        assert f == []
+
+
+class TestHostSyncRule:
+    def test_item_in_jitted_function(self):
+        f = lint(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.sum().item()
+            """
+        )
+        assert _rules(f) == {"host-sync-in-jit"}
+
+    def test_transitive_reachability(self):
+        f = lint(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+
+            def helper(x):
+                return float(x.sum())
+            """
+        )
+        assert _rules(f) == {"host-sync-in-jit"}
+
+    @pytest.mark.parametrize(
+        "stmt",
+        [
+            "jax.device_get(x)",
+            "x.block_until_ready()",
+            "np.asarray(x)",
+        ],
+    )
+    def test_each_sync_call(self, stmt):
+        f = lint(
+            f"""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return {stmt}
+            """
+        )
+        assert _rules(f) == {"host-sync-in-jit"}
+
+    def test_host_code_not_flagged(self):
+        # same calls outside any jit-reachable function: clean
+        f = lint(
+            """
+            import numpy as np
+
+            def metrics(pred, true):
+                return float(np.mean(np.square(np.asarray(pred) - true)))
+            """
+        )
+        assert f == []
+
+    def test_flax_module_method_is_reachable(self):
+        f = lint(
+            """
+            from flax import linen as nn
+
+            class Model(nn.Module):
+                def __call__(self, x):
+                    return x.sum().item()
+            """
+        )
+        assert _rules(f) == {"host-sync-in-jit"}
+
+    def test_float_of_literal_ok(self):
+        f = lint(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * float("inf")
+            """
+        )
+        assert f == []
+
+
+class TestTracedControlFlowRule:
+    def test_if_on_jnp_value(self):
+        f = lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                if jnp.any(x > 0):
+                    return x
+                return -x
+            """
+        )
+        assert _rules(f) == {"traced-control-flow"}
+
+    def test_while_on_method_any(self):
+        f = lint(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                while (x > 0).all():
+                    x = x - 1
+                return x
+            """
+        )
+        assert _rules(f) == {"traced-control-flow"}
+
+    def test_static_shape_branching_ok(self):
+        f = lint(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                if x.ndim == 1:
+                    return x
+                return x[0]
+            """
+        )
+        assert f == []
+
+
+class TestUnfencedTimingRule:
+    BAD = """
+        import time
+
+        def bench(train_step, batches):
+            t0 = time.perf_counter()
+            for b in batches:
+                out = train_step(b)
+            return time.perf_counter() - t0
+        """
+
+    def test_span_without_fence(self):
+        f = lint(self.BAD)
+        assert _rules(f) == {"unfenced-timing"}
+        assert all(x.severity == "warning" for x in f)
+
+    def test_span_with_fence_ok(self):
+        f = lint(
+            """
+            import time
+
+            def bench(train_step, batches, fence):
+                t0 = time.perf_counter()
+                for b in batches:
+                    out = train_step(b)
+                fence(out)
+                return time.perf_counter() - t0
+            """
+        )
+        assert f == []
+
+    def test_span_without_dispatch_ok(self):
+        f = lint(
+            """
+            import time
+
+            def wall(load, path):
+                t0 = time.time()
+                data = load(path)
+                return data, time.time() - t0
+            """
+        )
+        assert f == []
+
+
+class TestMissingDonateRule:
+    def test_call_form(self):
+        f = lint(
+            """
+            import jax
+
+            def train_step(params, opt_state, batch):
+                return params, opt_state
+
+            fn = jax.jit(train_step)
+            """
+        )
+        assert _rules(f) == {"missing-donate"}
+
+    def test_decorator_form(self):
+        f = lint(
+            """
+            import jax
+
+            @jax.jit
+            def train_step(params, opt_state, batch):
+                return params, opt_state
+            """
+        )
+        assert "missing-donate" in _rules(f)
+
+    def test_donated_ok(self):
+        f = lint(
+            """
+            import jax
+
+            def train_step(params, opt_state, batch):
+                return params, opt_state
+
+            fn = jax.jit(train_step, donate_argnums=(0, 1))
+            """
+        )
+        assert f == []
+
+
+class TestSuppression:
+    def test_rule_specific(self):
+        f = lint("from jax import shard_map  # stmgcn: ignore[jax-compat-import]\n")
+        assert f == []
+
+    def test_bare_ignore(self):
+        f = lint("from jax import shard_map  # stmgcn: ignore\n")
+        assert f == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        f = lint("from jax import shard_map  # stmgcn: ignore[missing-donate]\n")
+        assert _rules(f) == {"jax-compat-import"}
+
+    def test_other_lines_unaffected(self):
+        f = lint(
+            "from jax import shard_map  # stmgcn: ignore\n"
+            "from jax import linear_util\n"
+        )
+        assert len(f) == 1 and f[0].line == 2
+
+
+class TestContractChecks:
+    def test_primitive_budget_fires(self):
+        jaxpr = jax.make_jaxpr(lambda x: jnp.sin(jnp.cos(x)) + x)(
+            jax.ShapeDtypeStruct((4,), jnp.float32)
+        )
+        assert count_primitives(jaxpr) >= 3
+        f = _check_one("toy", jaxpr, True, budget=1)
+        assert _rules(f) == {"primitive-budget"}
+
+    def test_weak_type_output_fires(self):
+        # exp of a python scalar stays weak — feeding it back recompiles
+        jaxpr = jax.make_jaxpr(lambda x: jnp.exp(2.0))(
+            jax.ShapeDtypeStruct((4,), jnp.float32)
+        )
+        f = _check_one("toy", jaxpr, True, budget=None)
+        assert _rules(f) == {"weak-type-output"}
+
+    def test_fp64_promotion_fires(self):
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            jaxpr = jax.make_jaxpr(lambda x: x.astype(jnp.float64))(
+                jax.ShapeDtypeStruct((4,), jnp.float32)
+            )
+        f = _check_one("toy", jaxpr, True, budget=None)
+        assert "fp64-promotion" in _rules(f)
+
+    def test_smoke_steps_pass(self):
+        assert check_step_contracts("smoke") == []
+
+
+class TestShardingChecks:
+    def test_bad_axis_name_fires(self, tmp_path):
+        (tmp_path / "bad.py").write_text(
+            "from jax.sharding import PartitionSpec as P\n"
+            'SPEC = P("dp", "regoin", None)\n'
+        )
+        f = check_partition_specs(str(tmp_path))
+        assert any(
+            x.rule == "partition-axis-name" and "regoin" in x.message for x in f
+        )
+
+    def test_variable_axis_names_skipped(self, tmp_path):
+        (tmp_path / "ok.py").write_text(
+            "from jax.sharding import PartitionSpec as P\n"
+            "def f(ax):\n"
+            "    return P(ax, None)\n"
+        )
+        f = check_partition_specs(str(tmp_path))
+        assert not [x for x in f if x.path.endswith("ok.py")]
+
+    def test_repo_placement_table_clean(self):
+        assert check_partition_specs() == []
+
+
+class TestShippedTreeClean:
+    def test_package_lints_clean(self):
+        findings = lint_package()
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+class TestCli:
+    def test_lint_subcommand_clean_exit(self):
+        from stmgcn_tpu.cli import main
+
+        assert main(["lint", "--no-contracts"]) == 0
+
+    def test_json_gate_on_fixture(self, tmp_path, capsys):
+        (tmp_path / "bad.py").write_text(
+            "from jax import shard_map\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return x.sum().item()\n"
+        )
+        from stmgcn_tpu.cli import main
+
+        rc = main(["lint", str(tmp_path), "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["errors"] == 2
+        assert {f["rule"] for f in payload["findings"]} == {
+            "jax-compat-import",
+            "host-sync-in-jit",
+        }
+
+    def test_list_rules(self, capsys):
+        from stmgcn_tpu.cli import main
+
+        assert main(["lint", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in RULES:
+            assert rule in out
+
+
+class TestReport:
+    def test_json_shape_stable(self):
+        payload = json.loads(render_json([]))
+        assert payload == {
+            "version": 1, "errors": 0, "warnings": 0, "findings": [],
+        }
+
+    def test_findings_sorted_by_location(self):
+        from stmgcn_tpu.analysis import Finding
+
+        fs = [
+            Finding(rule="b", path="z.py", line=9, message="m"),
+            Finding(rule="a", path="a.py", line=3, message="m"),
+        ]
+        payload = json.loads(render_json(fs))
+        assert [f["path"] for f in payload["findings"]] == ["a.py", "z.py"]
+
+
+class TestCompatShim:
+    """The satellite the linter motivates: the version-portable symbols."""
+
+    def test_shard_map_round_trip(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from stmgcn_tpu.utils.platform import shard_map
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs >= 2 virtual devices")
+        mesh = Mesh(np.array(devs[:2]), ("region",))
+        out = shard_map(
+            lambda v: v * 2,
+            mesh=mesh,
+            in_specs=P("region"),
+            out_specs=P("region"),
+        )(jnp.arange(4.0))
+        np.testing.assert_allclose(np.asarray(out), np.arange(4.0) * 2)
+
+    def test_axis_size_is_static(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from stmgcn_tpu.utils.platform import axis_size, shard_map
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs >= 2 virtual devices")
+        mesh = Mesh(np.array(devs[:2]), ("region",))
+        sizes = []
+
+        def f(v):
+            n = axis_size("region")
+            sizes.append(n)
+            return v + n
+
+        out = shard_map(
+            f, mesh=mesh, in_specs=P("region"), out_specs=P("region")
+        )(jnp.zeros(2))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+        # range()-compatible: the halo exchange builds ppermute tables
+        assert all(isinstance(int(s), int) for s in sizes)

@@ -10,9 +10,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from stmgcn_tpu.config import preset
+from stmgcn_tpu.utils.platform import shard_map
 from stmgcn_tpu.experiment import build_trainer
 from stmgcn_tpu.models import STMGCN
 from stmgcn_tpu.parallel import MeshPlacement, build_mesh, halo_exchange, mesh_from_config
@@ -94,9 +94,12 @@ class TestShardedEquivalence:
             losses_mesh.append(float(loss))
 
         np.testing.assert_allclose(losses_mesh, losses_single, rtol=1e-5)
+        # atol covers near-zero weights where cross-replica reduction
+        # order (vs the single-device sum) leaves O(1e-5) drift after the
+        # optimizer amplifies it over 3 steps; rtol still pins the rest
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=2e-5
             ),
             params_m, ref_params,
         )

@@ -7,8 +7,10 @@ Adam update), on whatever single chip JAX exposes. The record also carries
 ``mfu`` (analytic-FLOPs model utilization vs the chip's bf16 peak — see
 ``stmgcn_tpu/utils/flops.py``) and a ``variants`` table covering
 {fp32, bf16} x {plain scan, tuned fused/unrolled scan, fused Pallas
-kernel} — all numerically equivalent schedules of the same step; the
-headline is the fastest leg.
+kernel} plus ``float32/superstep`` (S train steps fused into one
+``lax.scan`` dispatch with on-device batch gather, per-step numbers) —
+all numerically equivalent schedules of the same step; the headline is
+the fastest leg.
 Timing methodology is chained-steps with a single readback fence
 (``stmgcn_tpu.utils.time_chained``): on this image's tunneled TPU backend,
 ``block_until_ready`` does not actually fence and a per-step sync costs a
@@ -67,6 +69,12 @@ ITERS = int(os.environ.get("STMGCN_BENCH_ITERS", 30))
 LSTM_UNROLL = int(os.environ.get("STMGCN_BENCH_LSTM_UNROLL", 1))
 LSTM_FUSED = os.environ.get("STMGCN_BENCH_LSTM_FUSED", "0") == "1"
 LSTM_BACKEND = os.environ.get("STMGCN_BENCH_LSTM_BACKEND", "xla")
+#: S for the float32/superstep leg: S train steps fused into one lax.scan
+#: dispatch with on-device batch gather (train/step.py make_superstep_fns),
+#: measured over the tuned LSTM schedule so the delta vs float32/tuned is
+#: pure dispatch amortization. Overriding moves the run off the canonical
+#: point (it changes what the superstep leg measures).
+SUPERSTEP = int(os.environ.get("STMGCN_BENCH_SUPERSTEP", 8))
 CUSTOM_SCHEDULE = (
     "STMGCN_BENCH_LSTM_UNROLL" in os.environ
     or "STMGCN_BENCH_LSTM_FUSED" in os.environ
@@ -101,9 +109,18 @@ CANONICAL_POINT = not any(
 BENCH_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks")
 
 
+#: the real stdout, captured before measurement aliases sys.stdout to
+#: stderr (below): the driver parses stdout as EXACTLY one JSON line, so
+#: every other write — retry diagnostics, library chatter, stray prints
+#: in anything bench imports — must land on stderr. The in-repo prints
+#: all say ``file=sys.stderr`` already; the alias is the backstop for
+#: code this script doesn't control.
+_RECORD_STREAM = sys.stdout
+
+
 def _emit(record: dict) -> None:
     """Print the one-line JSON record and exit 0 (driver parses stdout)."""
-    print(json.dumps(record))
+    print(json.dumps(record), file=_RECORD_STREAM, flush=True)
     sys.exit(0)
 
 
@@ -182,22 +199,16 @@ def _measure(
     return _run_leg(fns, sup, x, y, mask, warmup, iters, **flops_kwargs)
 
 
-def build_canonical_step(
-    dtype: str, unroll: int = 1, fused: bool = False, backend: str = "xla"
-):
-    """The flagship train step's pieces at the canonical operating point.
-
-    Returns ``(fns, sup, x, y, mask, flops_kwargs)`` — the ONE
-    construction of the benchmark model/shapes, shared by this script's
-    legs and the decomposition/sweep tools under ``benchmarks/`` so they
-    can never measure a different model than the headline does.
-    """
+def _canonical_parts(dtype: str, unroll: int, fused: bool, backend: str):
+    """Model/optimizer/dataset at the canonical point — the ONE
+    construction shared by the per-step legs (``build_canonical_step``)
+    and the superstep leg, so neither can measure a different model."""
     import jax.numpy as jnp
 
     from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
     from stmgcn_tpu.models import STMGCN
     from stmgcn_tpu.ops import SupportConfig
-    from stmgcn_tpu.train import make_optimizer, make_step_fns
+    from stmgcn_tpu.train import make_optimizer
 
     seq_len = SERIAL + DAILY + WEEKLY
     data = synthetic_dataset(rows=ROWS, n_timesteps=24 * 7 * 2 + 4 * BATCH, seed=0)
@@ -216,13 +227,8 @@ def build_canonical_step(
         lstm_backend=backend,
         dtype=jnp.bfloat16 if dtype == "bfloat16" else None,
     )
-    fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
-
-    batch = next(dataset.batches("train", BATCH, pad_last=True))
+    optimizer = make_optimizer(2e-3, 1e-4)
     sup = jnp.asarray(supports)
-    x = jnp.asarray(batch.x)
-    y = jnp.asarray(batch.y)
-    mask = jnp.ones(BATCH, jnp.float32)
     flops_kwargs = dict(
         batch=BATCH,
         seq_len=seq_len,
@@ -234,6 +240,32 @@ def build_canonical_step(
         lstm_num_layers=LSTM_LAYERS,
         gcn_hidden_dim=GCN_HIDDEN,
     )
+    return model, optimizer, dataset, sup, flops_kwargs
+
+
+def build_canonical_step(
+    dtype: str, unroll: int = 1, fused: bool = False, backend: str = "xla"
+):
+    """The flagship train step's pieces at the canonical operating point.
+
+    Returns ``(fns, sup, x, y, mask, flops_kwargs)`` — the ONE
+    construction of the benchmark model/shapes, shared by this script's
+    legs and the decomposition/sweep tools under ``benchmarks/`` so they
+    can never measure a different model than the headline does.
+    """
+    import jax.numpy as jnp
+
+    from stmgcn_tpu.train import make_step_fns
+
+    model, optimizer, dataset, sup, flops_kwargs = _canonical_parts(
+        dtype, unroll, fused, backend
+    )
+    fns = make_step_fns(model, optimizer, "mse")
+
+    batch = next(dataset.batches("train", BATCH, pad_last=True))
+    x = jnp.asarray(batch.x)
+    y = jnp.asarray(batch.y)
+    mask = jnp.ones(BATCH, jnp.float32)
     return fns, sup, x, y, mask, flops_kwargs
 
 
@@ -260,6 +292,18 @@ def _run_leg(fns, sup, x, y, mask, warmup, iters, **flops_kwargs) -> dict:
         return state["loss"]
 
     step_s = time_chained(step, iters=iters, warmup=warmup)
+    return _leg_record(step_s, float(state["loss"]), **flops_kwargs)
+
+
+def _leg_record(step_s: float, final_loss: float, **flops_kwargs) -> dict:
+    """Assemble one leg's throughput/MFU record from its per-step seconds."""
+    from stmgcn_tpu.utils import (
+        device_peak_flops,
+        mfu,
+        region_timesteps_per_sec,
+        stmgcn_step_flops,
+    )
+
     flops = stmgcn_step_flops(**flops_kwargs)
     peak = device_peak_flops()
     util = mfu(flops, step_s, peak)
@@ -272,8 +316,65 @@ def _run_leg(fns, sup, x, y, mask, warmup, iters, **flops_kwargs) -> dict:
         "mfu": round(util, 4) if util is not None else None,
         "model_flops_per_step": flops,
         "peak_flops_bf16": peak,
-        "final_loss": float(state["loss"]),
+        "final_loss": final_loss,
     }
+
+
+def _measure_superstep(dtype: str, warmup: int, iters: int, s_steps: int) -> dict:
+    """The superstep leg: S fused train steps per dispatch, tuned schedule.
+
+    Uses the tuned LSTM schedule (unroll=0, fused scan — the best XLA
+    per-step leg) so the delta vs ``<dtype>/tuned`` isolates dispatch
+    amortization: same math, S-fold fewer host round-trips. The train
+    split stays device-resident and each scan step gathers its microbatch
+    on device from an ``(S, B)`` index block, exactly the trainer's
+    ``steps_per_superstep`` path. ``step_ms``/``value`` are per *train
+    step* (superstep time / S) so the variants table stays comparable.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from stmgcn_tpu.train import make_step_fns, make_superstep_fns
+    from stmgcn_tpu.utils import time_chained
+
+    if s_steps < 1:
+        raise ValueError(f"STMGCN_BENCH_SUPERSTEP must be >= 1, got {s_steps}")
+    model, optimizer, dataset, sup, flops_kwargs = _canonical_parts(
+        dtype, unroll=0, fused=True, backend="xla"
+    )
+    fns = make_step_fns(model, optimizer, "mse")
+    sfns = make_superstep_fns(model, optimizer, "mse")
+
+    x_np, y_np = dataset.arrays("train")
+    x_all, y_all = jnp.asarray(x_np), jnp.asarray(y_np)
+    index_rows = [
+        np.asarray(b.indices, np.int32)
+        for b in dataset.batches("train", BATCH, pad_last=True, with_arrays=False)
+    ]
+    idx_block = jnp.asarray(
+        np.stack([index_rows[i % len(index_rows)] for i in range(s_steps)])
+    )
+    mask_block = jnp.ones((s_steps, BATCH), jnp.float32)
+
+    params, opt_state = fns.init(
+        jax.random.key(0), sup, jnp.take(x_all, idx_block[0], axis=0)
+    )
+    state = {"params": params, "opt_state": opt_state, "loss": None}
+
+    def superstep():
+        state["params"], state["opt_state"], state["loss"] = sfns.train_superstep(
+            state["params"], state["opt_state"], sup, x_all, y_all,
+            idx_block, mask_block,
+        )
+        return state["loss"]
+
+    superstep_s = time_chained(superstep, iters=iters, warmup=warmup)
+    leg = _leg_record(
+        superstep_s / s_steps, float(state["loss"][-1]), **flops_kwargs
+    )
+    leg["s_steps"] = s_steps
+    return leg
 
 
 def _measure_scaled(sparse: bool, warmup: int, iters: int) -> dict:
@@ -489,6 +590,18 @@ def main() -> None:
             except Exception as e:  # keep surviving legs: one bad leg must
                 measure_err = f"{d}/{sched}: {type(e).__name__}: {e}"  # not void all
                 print(f"bench: measurement failed for {measure_err}", file=sys.stderr)
+    if not CUSTOM_SCHEDULE and "float32" in dtypes:
+        # the superstep leg (S fused steps per dispatch over the tuned
+        # schedule); iteration counts scale down by S so the leg runs a
+        # comparable number of real train steps to the per-step legs
+        warmup, iters = (1, 2) if probe_err is not None else (2, max(2, ITERS // SUPERSTEP))
+        try:
+            results["float32/superstep"] = _measure_superstep(
+                "float32", warmup, iters, SUPERSTEP
+            )
+        except Exception as e:
+            measure_err = f"float32/superstep: {type(e).__name__}: {e}"
+            print(f"bench: measurement failed for {measure_err}", file=sys.stderr)
     if not results:
         raise RuntimeError(measure_err or "no configuration measured")
 
@@ -556,7 +669,10 @@ def main() -> None:
         "final_loss": loss if math.isfinite(loss) else None,
         "baseline": baseline,
         "variants": {
-            k: {"value": r["value"], "step_ms": r["step_ms"], "mfu": r["mfu"]}
+            k: {
+                "value": r["value"], "step_ms": r["step_ms"], "mfu": r["mfu"],
+                **({"s_steps": r["s_steps"]} if "s_steps" in r else {}),
+            }
             for k, r in results.items()
         },
         "host_load": _provenance(lock, load_before),
@@ -633,6 +749,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    sys.stdout = sys.stderr  # backstop: only _emit writes the record stream
     try:
         main()
     except SystemExit:

@@ -7,6 +7,8 @@ Usage::
     stmgcn lint --format json        # machine-readable report (CI)
     stmgcn lint --no-contracts       # AST pass only (no JAX import/trace)
     stmgcn lint --list-rules         # rule table
+    stmgcn lint --rebaseline         # rewrite PRIMITIVE_BUDGETS from
+                                     # measured counts (+~2x headroom)
 
 Exit code 1 when any *error*-severity finding survives suppression;
 warnings are reported but do not gate. The contract pass (jaxpr +
@@ -41,6 +43,12 @@ def build_lint_parser() -> argparse.ArgumentParser:
                         "smoke)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
+    p.add_argument("--rebaseline", action="store_true",
+                   help="measure the step programs' primitive counts and "
+                        "rewrite PRIMITIVE_BUDGETS (measured x ~2 headroom) "
+                        "in stmgcn_tpu/analysis/jaxpr_check.py, then exit — "
+                        "the deliberate-rebaseline command for features that "
+                        "move a step's op count")
     return p
 
 
@@ -53,6 +61,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         width = max(len(r) for r in RULES)
         for rule in RULES.values():
             print(f"{rule.id:<{width}}  {rule.severity:<7}  {rule.summary}")
+        return 0
+
+    if args.rebaseline:
+        import json
+
+        from stmgcn_tpu.analysis.jaxpr_check import rebaseline
+        from stmgcn_tpu.utils.platform import force_host_platform
+
+        force_host_platform("cpu")  # never queue on (or wake) an accelerator
+        result = rebaseline(preset_name=args.preset)
+        if args.format == "json":
+            print(json.dumps(result))
+        else:
+            for name, count in result["counts"].items():
+                print(
+                    f"{name}: measured {count} primitives -> "
+                    f"budget {result['budgets'][name]}"
+                )
+            print(f"rewrote PRIMITIVE_BUDGETS in {result['path']}")
         return 0
 
     from stmgcn_tpu.analysis.lint import lint_package, lint_paths

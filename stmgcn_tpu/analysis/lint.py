@@ -193,6 +193,7 @@ class _Linter:
                 self._check_traced_body(fn)
         self._check_compat_attrs()
         self._check_donate()
+        self._check_recompile_hazard()
         return self.findings
 
     # -- jax-compat-import -------------------------------------------------
@@ -367,6 +368,93 @@ class _Linter:
                         kwargs = {kw.arg for kw in dec.keywords}
                         if not kwargs & {"donate_argnums", "donate_argnames"}:
                             self._emit("missing-donate", dec, self._DONATE_MSG)
+
+    # -- recompile-hazard --------------------------------------------------
+    #: AST nodes that build a brand-new object on every evaluation — as a
+    #: static arg they miss (lambda: fresh identity) or break (list/dict/
+    #: set: unhashable) the jit cache on every call
+    _FRESH_NODES = (
+        ast.Lambda, ast.List, ast.Dict, ast.Set,
+        ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+    )
+
+    @staticmethod
+    def _const_values(node: ast.AST, typ) -> Set:
+        return {
+            sub.value
+            for sub in ast.walk(node)
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, typ)
+        }
+
+    def _check_recompile_hazard(self) -> None:
+        # sweep A: ``jax.jit(f)(...)`` invoked in place — a fresh wrapper
+        # (with an empty trace cache) every evaluation. Binding the wrapper
+        # (``g = jax.jit(f)``, the factory pattern) is the fix and is not
+        # flagged.
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Call)
+                and self._is_jit(node.func.func)
+            ):
+                self._emit(
+                    "recompile-hazard", node,
+                    "jax.jit(...) invoked in place — every evaluation builds "
+                    "a fresh wrapper with an empty trace cache; bind the "
+                    "jitted function once and reuse it",
+                )
+        # sweep B: fresh/unhashable literals handed to a jitted wrapper's
+        # static positions. First map ``g = jax.jit(f, static_argnums=...)``
+        # assignments to their static positions/names, then flag calls of
+        # ``g`` that pass a per-call-fresh object there.
+        static: Dict[str, tuple] = {}
+        for node in ast.walk(self.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and self._is_jit(node.value.func)
+            ):
+                continue
+            nums: Set[int] = set()
+            names: Set[str] = set()
+            for kw in node.value.keywords:
+                if kw.arg == "static_argnums":
+                    nums |= self._const_values(kw.value, int)
+                elif kw.arg == "static_argnames":
+                    names |= self._const_values(kw.value, str)
+            if not (nums or names):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    static[t.id] = (nums, names)
+        if not static:
+            return
+
+        def flag(call: ast.Call, value: ast.AST, where: str) -> None:
+            kind = type(value).__name__.lower()
+            self._emit(
+                "recompile-hazard", value,
+                f"fresh {kind} passed at static {where} of jitted "
+                f"`{call.func.id}` — static args are cached by value/"
+                "identity, so a per-call object retraces (lambda) or raises "
+                "TypeError: unhashable (list/dict/set) every call; hoist it "
+                "to a stable binding",
+            )
+
+        for node in ast.walk(self.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in static
+            ):
+                continue
+            nums, names = static[node.func.id]
+            for i, arg in enumerate(node.args):
+                if i in nums and isinstance(arg, self._FRESH_NODES):
+                    flag(node, arg, f"position {i}")
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, self._FRESH_NODES):
+                    flag(node, kw.value, f"argname `{kw.arg}`")
 
 
 def lint_source(source: str, path: str = "<string>") -> List[Finding]:

@@ -60,6 +60,14 @@ _ALL_RULES = [
         "jax.jit of a train-step-like function without donate_argnums — "
         "params/opt-state buffers are copied instead of reused every step",
     ),
+    Rule(
+        "recompile-hazard",
+        "warning",
+        "a fresh object reaches jax.jit's trace cache every call — "
+        "jit(...) invoked in place (new wrapper, empty cache) or a "
+        "lambda/list/dict literal at a static_argnums/static_argnames "
+        "position (new identity/unhashable value -> retrace or TypeError)",
+    ),
     # -- pass 2: jaxpr / sharding contracts ------------------------------
     Rule(
         "fp64-promotion",

@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batch data residency: upload splits once and gather "
                         "on device (resident), upload per batch with "
                         "prefetch (stream), or pick by device/size (auto)")
+    p.add_argument("--steps-per-superstep", type=_positive_int, default=None,
+                   metavar="S",
+                   help="fuse S train steps into one jitted lax.scan "
+                        "dispatch with on-device batch gather (needs "
+                        "resident data + shared graphs; bit-identical "
+                        "results, S-fold fewer host dispatches; default 1)")
     p.add_argument("--normalize", choices=("minmax", "std", "none"), default=None,
                    help="demand normalization (reference parity: minmax to "
                         "[-1,1]; stats travel inside checkpoints either way)")
@@ -187,6 +193,7 @@ def config_from_args(args) -> "ExperimentConfig":
         ("patience", "patience"), ("top_k", "top_k"), ("seed", "seed"),
         ("checks", "checks"),
         ("out_dir", "out_dir"), ("data_placement", "data_placement"),
+        ("steps_per_superstep", "steps_per_superstep"),
     ]:
         val = getattr(args, field)
         if val is not None:

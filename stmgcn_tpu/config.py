@@ -175,6 +175,13 @@ class TrainConfig:
     #: "auto" picks resident on a single device when the windowed arrays
     #: fit comfortably in HBM, else stream
     data_placement: str = "auto"
+    #: fuse S train steps into one jitted lax.scan dispatch with on-device
+    #: microbatch gather (train/step.py make_superstep_fns): one host
+    #: dispatch + one loss readback per S optimizer steps. 1 (default) is
+    #: the per-step loop; >1 requires resident data with one shared graph
+    #: stack and otherwise silently falls back to per-step. Results are
+    #: bit-identical either way — this is purely a dispatch-overhead knob
+    steps_per_superstep: int = 1
     #: write checkpoint files from a background worker (serialization —
     #: the device->host snapshot — stays on the training thread; reads
     #: flush pending writes first)

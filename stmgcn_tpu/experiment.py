@@ -452,6 +452,7 @@ def build_trainer(
         top_k=t.top_k,
         prefetch=t.prefetch,
         data_placement=t.data_placement,
+        steps_per_superstep=t.steps_per_superstep,
         async_checkpoint=t.async_checkpoint,
         shuffle=t.shuffle,
         seed=t.seed,

@@ -10,7 +10,13 @@ semantics match the reference exactly (``Model_Trainer.py:47-60``).
 
 from stmgcn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
 from stmgcn_tpu.train.metrics import MAE, MAPE, MSE, PCC, RMSE, regression_report
-from stmgcn_tpu.train.step import StepFns, make_optimizer, make_step_fns
+from stmgcn_tpu.train.step import (
+    StepFns,
+    SuperstepFns,
+    make_optimizer,
+    make_step_fns,
+    make_superstep_fns,
+)
 from stmgcn_tpu.train.trainer import CitySupports, Trainer
 
 __all__ = [
@@ -21,10 +27,12 @@ __all__ = [
     "PCC",
     "RMSE",
     "StepFns",
+    "SuperstepFns",
     "Trainer",
     "load_checkpoint",
     "make_optimizer",
     "make_step_fns",
+    "make_superstep_fns",
     "regression_report",
     "save_checkpoint",
 ]

@@ -26,7 +26,13 @@ import jax
 import jax.numpy as jnp
 import optax
 
-__all__ = ["StepFns", "make_optimizer", "make_step_fns"]
+__all__ = [
+    "StepFns",
+    "SuperstepFns",
+    "make_optimizer",
+    "make_step_fns",
+    "make_superstep_fns",
+]
 
 LOSSES = ("mse", "mae", "huber")
 
@@ -127,40 +133,32 @@ class StepFns:
     eval_step: Callable  # (params, supports, x, y, mask) -> (loss, pred)
 
 
+@dataclasses.dataclass(frozen=True)
+class SuperstepFns:
+    """A jitted S-step training superstep (see :func:`make_superstep_fns`)."""
+
+    #: (params, opt_state, supports, x_all, y_all, idx_block, mask_block)
+    #: -> (params, opt_state, losses); idx_block is (S, B) int32 into the
+    #: leading axis of the resident x_all/y_all, mask_block stacks the
+    #: per-step loss masks ((S, B) or (S, B, N)), losses comes back (S,)
+    train_superstep: Callable
+
+
 #: checkify error-set names accepted by ``make_step_fns(checks=...)``
 CHECK_SETS = ("nan", "index", "float", "all")
 
 
-def make_step_fns(
-    model,
-    optimizer: optax.GradientTransformation,
-    loss: str = "mse",
-    checks: str | None = None,
-) -> StepFns:
-    """Build jitted init/train/eval steps for a flax model.
+def _raw_step_bodies(model, optimizer, loss: str):
+    """The unjitted init/train/eval bodies shared by :func:`make_step_fns`
+    and :func:`make_superstep_fns`.
 
-    ``mask`` is a ``(B,)`` 0/1 vector (1 = real sample) or, when the node
-    axis carries mesh-divisibility padding, a ``(B, N)`` 0/1 matrix
-    (sample x real-node); the loss is the mean over real elements only, so
-    padded tail batches and padded nodes yield exactly the loss of the
-    unpadded equivalent.
-
-    ``checks`` enables functional sanitizing via ``jax.experimental
-    .checkify`` — the in-jit analogue of the sanitizers the reference
-    has no counterpart for (SURVEY.md §5.b): ``"nan"`` traps NaN
-    production, ``"index"`` out-of-bounds gathers/scatters, ``"float"``
-    is nan + division-by-zero (NOT index — jax's ``float_checks`` does
-    not include it), ``"all"`` is everything plus user ``checkify.check``
-    calls.
-    The checked step raises ``JaxRuntimeError`` at the failing step with
-    the op's location. Debug tool: error flags are fetched per step, so
-    it costs a device sync per call — unlike ``jax_debug_nans`` it works
-    under jit *with* donation and on TPU without recompiling per op.
+    One definition site is what makes the superstep's bit-exactness claim
+    structural rather than coincidental: the scan body runs the *same*
+    Python function the per-step path jits, so the two paths can only
+    diverge if XLA itself breaks determinism.
     """
     if loss not in LOSSES:
         raise ValueError(f"loss must be one of {LOSSES}, got {loss!r}")
-    if checks is not None and checks not in CHECK_SETS:
-        raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
     def loss_fn(params, supports, x, y, mask):
         pred = model.apply(params, supports, x)
@@ -190,6 +188,40 @@ def make_step_fns(
     def eval_step(params, supports, x, y, mask):
         loss_val, pred = loss_fn(params, supports, x, y, mask)
         return loss_val, pred
+
+    return init, train_step, eval_step
+
+
+def make_step_fns(
+    model,
+    optimizer: optax.GradientTransformation,
+    loss: str = "mse",
+    checks: str | None = None,
+) -> StepFns:
+    """Build jitted init/train/eval steps for a flax model.
+
+    ``mask`` is a ``(B,)`` 0/1 vector (1 = real sample) or, when the node
+    axis carries mesh-divisibility padding, a ``(B, N)`` 0/1 matrix
+    (sample x real-node); the loss is the mean over real elements only, so
+    padded tail batches and padded nodes yield exactly the loss of the
+    unpadded equivalent.
+
+    ``checks`` enables functional sanitizing via ``jax.experimental
+    .checkify`` — the in-jit analogue of the sanitizers the reference
+    has no counterpart for (SURVEY.md §5.b): ``"nan"`` traps NaN
+    production, ``"index"`` out-of-bounds gathers/scatters, ``"float"``
+    is nan + division-by-zero (NOT index — jax's ``float_checks`` does
+    not include it), ``"all"`` is everything plus user ``checkify.check``
+    calls.
+    The checked step raises ``JaxRuntimeError`` at the failing step with
+    the op's location. Debug tool: error flags are fetched per step, so
+    it costs a device sync per call — unlike ``jax_debug_nans`` it works
+    under jit *with* donation and on TPU without recompiling per op.
+    """
+    if checks is not None and checks not in CHECK_SETS:
+        raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
+
+    init, train_step, eval_step = _raw_step_bodies(model, optimizer, loss)
 
     # init is jitted too: eager flax init dispatches hundreds of tiny ops,
     # which is pathologically slow on remote-tunneled TPU backends.
@@ -225,3 +257,81 @@ def make_step_fns(
         return out
 
     return StepFns(init=jax.jit(init), train_step=checked_train, eval_step=checked_eval)
+
+
+def make_superstep_fns(
+    model,
+    optimizer: optax.GradientTransformation,
+    loss: str = "mse",
+    checks: str | None = None,
+) -> SuperstepFns:
+    """Fuse S train steps into one jitted ``lax.scan`` over microbatches.
+
+    The per-step epoch loop pays host dispatch latency once per batch; on
+    remote-tunneled TPU backends that dominates small-step wall time. The
+    superstep instead runs S optimizer steps inside a single device
+    program: ``(params, opt_state)`` ride the scan carry (donated, so the
+    buffers update in place), each step gathers its microbatch **on
+    device** from the mode's resident arrays via a row of the ``(S, B)``
+    ``idx_block``, and the S per-step losses come back as one stacked
+    ``(S,)`` array — one dispatch and one host readback per S steps.
+
+    The scan body is the *same* raw train step :func:`make_step_fns` jits
+    (shared via ``_raw_step_bodies``), and the losses are returned in step
+    order as scan ys rather than accumulated in the carry, so a
+    superstep's results — params, opt state, and every per-step loss — are
+    bit-identical to S iterations of the per-step loop over the same
+    index/mask rows.
+
+    S is not fixed here: it is the leading axis of ``idx_block`` /
+    ``mask_block``, so jit specializes per block shape (the trainer packs
+    fixed-S blocks; the remainder batches run per-step).
+
+    ``checks`` wraps the whole superstep in ``jax.experimental.checkify``
+    (same sets as :func:`make_step_fns`); the error surfaces after the
+    S-step program, not at the individual failing step.
+    """
+    if checks is not None and checks not in CHECK_SETS:
+        raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
+
+    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+
+    def train_superstep(params, opt_state, supports, x_all, y_all, idx_block, mask_block):
+        def body(carry, step_inputs):
+            params, opt_state = carry
+            idx, mask = step_inputs
+            x = jnp.take(x_all, idx, axis=0)
+            y = jnp.take(y_all, idx, axis=0)
+            params, opt_state, loss_val = train_step(
+                params, opt_state, supports, x, y, mask
+            )
+            return (params, opt_state), loss_val
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (idx_block, mask_block)
+        )
+        return params, opt_state, losses
+
+    if checks is None:
+        return SuperstepFns(
+            train_superstep=jax.jit(train_superstep, donate_argnums=(0, 1))
+        )
+
+    from jax.experimental import checkify
+
+    errset = {
+        "nan": checkify.nan_checks,
+        "index": checkify.index_checks,
+        "float": checkify.float_checks,  # nan + div (no index checks)
+        "all": checkify.all_checks,
+    }[checks]
+    ck = jax.jit(
+        checkify.checkify(train_superstep, errors=errset), donate_argnums=(0, 1)
+    )
+
+    def checked_superstep(params, opt_state, supports, x_all, y_all, idx_block, mask_block):
+        err, out = ck(params, opt_state, supports, x_all, y_all, idx_block, mask_block)
+        checkify.check_error(err)  # device sync; raises after the failing block
+        return out
+
+    return SuperstepFns(train_superstep=checked_superstep)

@@ -49,7 +49,7 @@ from stmgcn_tpu.train.checkpoint import (
     write_checkpoint_bytes,
 )
 from stmgcn_tpu.train.metrics import regression_report
-from stmgcn_tpu.train.step import make_optimizer, make_step_fns
+from stmgcn_tpu.train.step import make_optimizer, make_step_fns, make_superstep_fns
 
 __all__ = ["Trainer"]
 
@@ -150,6 +150,7 @@ class Trainer:
         prefetch: int = 1,
         node_pad=0,
         data_placement: str = "auto",
+        steps_per_superstep: int = 1,
         async_checkpoint: bool = True,
         placement=None,
         extra_meta: Optional[dict] = None,
@@ -197,6 +198,17 @@ class Trainer:
                 f"data_placement must be auto|resident|stream, got {data_placement!r}"
             )
         self.data_placement = data_placement
+        if steps_per_superstep < 1:
+            raise ValueError(
+                f"steps_per_superstep must be >= 1, got {steps_per_superstep}"
+            )
+        #: S optimizer steps fused into one jitted lax.scan dispatch
+        #: (train/step.py make_superstep_fns). 1 = the per-step loop.
+        #: >1 engages only where the superstep can gather on device:
+        #: resident data, one shared support stack, no per-city models —
+        #: anything else silently falls back to the per-step loop, which
+        #: is bit-identical anyway.
+        self.steps_per_superstep = steps_per_superstep
         self._resident_cache: dict = {}
         #: serialize on the training thread (device->host snapshot), write
         #: the file from a background worker — IO leaves the epoch's
@@ -274,6 +286,11 @@ class Trainer:
 
         self._make_fns = _fresh_fns
         self.step_fns = _fresh_fns(model)
+        # built lazily on first superstep epoch — most trainers never need it
+        self._make_superstep_fns = lambda: make_superstep_fns(
+            model, optimizer, loss, checks=checks
+        )
+        self._superstep_fns = None
         # Per-city gate pooling under per-city node padding: cities with
         # padded node rows need their own n_real_nodes (a static module
         # attribute), so their steps close over a clone of the model. jit
@@ -505,16 +522,24 @@ class Trainer:
             by = self._pad_nodes(by, by.ndim - 2, pad)  # (B,[H,]N,C)
         return self.placement.put(bx, "x"), self.placement.put(by, "y"), mask
 
+    def _mask_np(self, sample_mask, n_padded_nodes: int, pad: int) -> np.ndarray:
+        """Loss mask: samples, crossed with real-node rows when node-padded.
+
+        Host-side numpy — the superstep path stacks S of these into one
+        block before placing it; the per-step path places each via
+        :meth:`_mask`.
+        """
+        if not pad:
+            return sample_mask
+        node_mask = (
+            np.arange(n_padded_nodes) < n_padded_nodes - pad
+        ).astype(np.float32)
+        return sample_mask[:, None] * node_mask[None, :]
+
     def _mask(self, sample_mask, n_padded_nodes: int, pad: int):
-        """Loss mask: samples, crossed with real-node rows when node-padded."""
-        if pad:
-            node_mask = (
-                np.arange(n_padded_nodes) < n_padded_nodes - pad
-            ).astype(np.float32)
-            mask = sample_mask[:, None] * node_mask[None, :]
-        else:
-            mask = sample_mask
-        return self.placement.put(mask, "mask")
+        return self.placement.put(
+            self._mask_np(sample_mask, n_padded_nodes, pad), "mask"
+        )
 
     def _resident_arrays(self, mode: str, city: int):
         """Device copies of a mode's full (x, y), uploaded once per run."""
@@ -540,6 +565,23 @@ class Trainer:
         widths[axis] = (0, pad)
         return np.pad(arr, widths)
 
+    def _superstep_ready(self) -> bool:
+        """Whether training epochs can take the fused superstep path.
+
+        The superstep gathers microbatches on device from one resident
+        (x, y) pool against one support stack and one compiled model —
+        streaming data, per-city graphs (``CitySupports``), and per-city
+        model clones (heterogeneous node padding) all fall back to the
+        per-step loop, which computes the identical result.
+        """
+        return (
+            self.steps_per_superstep > 1
+            and self._resident
+            and self.dataset.shared_graphs
+            and not isinstance(self.supports, CitySupports)
+            and self._city_n_real is None
+        )
+
     def _run_epoch(self, mode: str, train: bool) -> float:
         """Sample-weighted mean loss over a mode (``Model_Trainer.py:43-44``).
 
@@ -547,6 +589,8 @@ class Trainer:
         ``float(loss)`` would fence the pipeline every step and serialize
         host batch prep with device compute.
         """
+        if train and self._superstep_ready():
+            return self._run_epoch_superstep(mode)
         losses, counts = [], []
         for batch, (x, y, mask) in self._placed_batches(
             mode, shuffle=self.shuffle and train
@@ -565,6 +609,81 @@ class Trainer:
             raise ValueError(f"no samples in mode {mode!r}")
         weights = np.asarray(counts, dtype=np.float32)
         weighted = jnp.stack(losses) @ jnp.asarray(weights)
+        return float(weighted) / float(weights.sum())
+
+    def _pack_blocks(self, batches, mode: str):
+        """Stack index-only batches into (idx_block, mask_block, n_reals)
+        triples of exactly S steps each; the tail short of a full S runs
+        per-step (a zero-real padded scan step would divide 0/0 in the
+        loss and poison the Adam moments — parity forbids it)."""
+        S = self.steps_per_superstep
+        x_all, y_all = self._resident_arrays(mode, 0)
+        n_nodes = y_all.shape[y_all.ndim - 2]
+        pad = self._pad_for(0)
+        blocks = []
+        for i in range(len(batches) // S):
+            chunk = batches[i * S:(i + 1) * S]
+            idx_block = np.stack([b.indices for b in chunk]).astype(np.int32)
+            mask_block = np.stack([
+                self._mask_np(
+                    (np.arange(len(b)) < b.n_real).astype(np.float32),
+                    n_nodes, pad,
+                )
+                for b in chunk
+            ])
+            blocks.append((idx_block, mask_block, [b.n_real for b in chunk]))
+        return blocks, batches[(len(batches) // S) * S:]
+
+    def _run_epoch_superstep(self, mode: str) -> float:
+        """Training epoch as fused S-step dispatches (module docstring;
+        train/step.py ``make_superstep_fns``).
+
+        Packs the epoch's index-only batches into ``(S, B)`` blocks, keeps
+        the *next* block's host->device copy in flight while the current
+        superstep computes (double buffering — ``jnp.asarray`` issues the
+        copy asynchronously), and runs the final ``n_batches % S`` batches
+        through the ordinary per-step path. Per-step losses come back in
+        batch order, so the epoch loss reduction is elementwise identical
+        to the per-step loop's.
+        """
+        if self._superstep_fns is None:
+            self._superstep_fns = self._make_superstep_fns()
+        x_all, y_all = self._resident_arrays(mode, 0)
+        sup = self.supports
+        batches = list(self.dataset.batches(
+            mode, self.batch_size, shuffle=self.shuffle, seed=self.seed,
+            epoch=self.epoch, pad_last=True, with_arrays=False,
+        ))
+        blocks, remainder = self._pack_blocks(batches, mode)
+        losses, counts = [], []
+
+        def place(block):
+            idx_np, mask_np, n_reals = block
+            return jnp.asarray(idx_np), jnp.asarray(mask_np), n_reals
+
+        placed = place(blocks[0]) if blocks else None
+        for i in range(len(blocks)):
+            idx_d, mask_d, n_reals = placed
+            self.params, self.opt_state, loss_vec = (
+                self._superstep_fns.train_superstep(
+                    self.params, self.opt_state, sup, x_all, y_all, idx_d, mask_d
+                )
+            )
+            # superstep i is dispatched; upload block i+1 under its compute
+            placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+            losses.append(loss_vec)  # (S,) — stays on device
+            counts.extend(n_reals)
+        for batch in remainder:
+            x, y, mask = self._place_batch(batch, mode)
+            self.params, self.opt_state, loss = self.step_fns.train_step(
+                self.params, self.opt_state, sup, x, y, mask
+            )
+            losses.append(jnp.atleast_1d(loss))
+            counts.append(batch.n_real)
+        if not counts:
+            raise ValueError(f"no samples in mode {mode!r}")
+        weights = np.asarray(counts, dtype=np.float32)
+        weighted = jnp.concatenate(losses) @ jnp.asarray(weights)
         return float(weighted) / float(weights.sum())
 
     # -- public API -----------------------------------------------------

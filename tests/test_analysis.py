@@ -286,6 +286,88 @@ class TestMissingDonateRule:
         assert f == []
 
 
+class TestRecompileHazardRule:
+    def test_jit_invoked_in_place(self):
+        f = lint(
+            """
+            import jax
+
+            def hot(x):
+                return jax.jit(lambda v: v + 1)(x)
+            """
+        )
+        assert _rules(f) == {"recompile-hazard"}
+        assert all(x.severity == "warning" for x in f)
+
+    def test_lambda_at_static_argnum(self):
+        f = lint(
+            """
+            import jax
+
+            def f(x, act):
+                return act(x)
+
+            g = jax.jit(f, static_argnums=(1,))
+
+            def use(x):
+                return g(x, lambda v: v * 2)
+            """
+        )
+        assert _rules(f) == {"recompile-hazard"}
+        assert "position 1" in f[0].message
+
+    def test_dict_at_static_argname(self):
+        f = lint(
+            """
+            import jax
+
+            def f(x, cfg=None):
+                return x
+
+            g = jax.jit(f, static_argnames=("cfg",))
+
+            def use(x):
+                return g(x, cfg={"k": 1})
+            """
+        )
+        assert _rules(f) == {"recompile-hazard"}
+        assert "unhashable" in f[0].message
+
+    def test_factory_and_module_binding_ok(self):
+        # the two blessed shapes: a factory returning the bound wrapper
+        # (make_step_fns) and a module-scope jit-of-lambda bound once
+        f = lint(
+            """
+            import jax
+
+            def make(f):
+                return jax.jit(f, donate_argnums=(0, 1))
+
+            g = jax.jit(lambda v: v + 1)
+
+            def use(x):
+                return g(x)
+            """
+        )
+        assert f == []
+
+    def test_hashable_static_value_ok(self):
+        f = lint(
+            """
+            import jax
+
+            def f(x, k):
+                return x * k
+
+            g = jax.jit(f, static_argnums=(1,))
+
+            def use(x):
+                return g(x, 3)
+            """
+        )
+        assert f == []
+
+
 class TestSuppression:
     def test_rule_specific(self):
         f = lint("from jax import shard_map  # stmgcn: ignore[jax-compat-import]\n")
@@ -336,6 +418,72 @@ class TestContractChecks:
 
     def test_smoke_steps_pass(self):
         assert check_step_contracts("smoke") == []
+
+    def test_superstep_program_within_budget(self):
+        """The fused S-step scan is a checked program with its own budget
+        (satellite of the superstep PR): present, measured, and under."""
+        from stmgcn_tpu.analysis.jaxpr_check import (
+            PRIMITIVE_BUDGETS,
+            measured_primitive_counts,
+        )
+
+        assert "train_superstep" in PRIMITIVE_BUDGETS
+        counts = measured_primitive_counts("smoke")
+        assert set(counts) == set(PRIMITIVE_BUDGETS)
+        for name, count in counts.items():
+            assert 0 < count <= PRIMITIVE_BUDGETS[name], name
+
+
+class TestRebaseline:
+    def test_rewrites_literal_and_reports(self, tmp_path):
+        """--rebaseline against a copy: the single-line literal is
+        rewritten to measured x headroom (rounded up to 10s) and the
+        returned budgets round-trip through the rewritten source."""
+        import math
+
+        import stmgcn_tpu.analysis.jaxpr_check as jc
+
+        target = tmp_path / "jaxpr_check_copy.py"
+        target.write_text(open(jc.__file__).read())
+        before = dict(jc.PRIMITIVE_BUDGETS)
+        try:
+            result = jc.rebaseline(path=str(target), headroom=3.0)
+            assert result["path"] == str(target)
+            assert result["budgets"] == {
+                name: int(math.ceil(c * 3.0 / 10.0) * 10)
+                for name, c in result["counts"].items()
+            }
+            # in-memory budgets updated so later contract checks see them
+            assert jc.PRIMITIVE_BUDGETS == result["budgets"]
+            line = next(
+                l for l in target.read_text().splitlines()
+                if l.startswith("PRIMITIVE_BUDGETS = ")
+            )
+            ns = {}
+            exec(line, ns)
+            assert ns["PRIMITIVE_BUDGETS"] == result["budgets"]
+        finally:
+            jc.PRIMITIVE_BUDGETS.clear()
+            jc.PRIMITIVE_BUDGETS.update(before)
+
+    def test_rejects_shrinking_headroom(self):
+        from stmgcn_tpu.analysis.jaxpr_check import rebaseline
+
+        with pytest.raises(ValueError, match="headroom"):
+            rebaseline(headroom=0.5)
+
+    def test_missing_literal_raises(self, tmp_path):
+        import stmgcn_tpu.analysis.jaxpr_check as jc
+
+        target = tmp_path / "no_literal.py"
+        target.write_text("x = 1\n")
+        before = dict(jc.PRIMITIVE_BUDGETS)
+        try:
+            with pytest.raises(RuntimeError, match="PRIMITIVE_BUDGETS"):
+                jc.rebaseline(path=str(target))
+        finally:
+            jc.PRIMITIVE_BUDGETS.clear()
+            jc.PRIMITIVE_BUDGETS.update(before)
 
 
 class TestShardingChecks:

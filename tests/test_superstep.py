@@ -1,0 +1,164 @@
+"""Superstep training: fused S-step scan parity and plumbing.
+
+The superstep path is a pure dispatch-granularity change: for any
+``steps_per_superstep`` it must compute bit-identical params, opt-state,
+losses, and histories to the per-step loop, and fall back to that loop
+wherever the fused on-device gather cannot apply (streamed data, per-city
+graphs/models). Parity here is exact equality, not allclose — the scan
+body IS the per-step body (train/step.py ``_raw_step_bodies``), so any
+drift means the paths diverged structurally.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.experiment import build_trainer
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.train import make_optimizer, make_step_fns, make_superstep_fns
+
+BATCH = 8
+S = 3  # with the smoke slice below: 20 train batches -> 6 blocks + 2 remainder
+
+
+def _train(tmp_path, s_steps, shuffle=False, placement="resident", epochs=2):
+    cfg = preset("smoke")
+    cfg.data.rows = 5
+    cfg.data.n_timesteps = 24 * 7 * 2 + 60
+    cfg.train.epochs = epochs
+    cfg.train.batch_size = BATCH
+    cfg.train.data_placement = placement
+    cfg.train.shuffle = shuffle
+    cfg.train.steps_per_superstep = s_steps
+    cfg.train.out_dir = str(tmp_path / f"{placement}-s{s_steps}-{shuffle}")
+    trainer = build_trainer(cfg, verbose=False)
+    history = trainer.train()
+    return trainer, history
+
+
+def _assert_same_state(a, b):
+    jax.tree.map(np.testing.assert_array_equal, a.params, b.params)
+    jax.tree.map(
+        np.testing.assert_array_equal,
+        jax.tree.leaves(a.opt_state), jax.tree.leaves(b.opt_state),
+    )
+
+
+@pytest.mark.parametrize(
+    "shuffle", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
+def test_superstep_bit_identical_to_per_step(tmp_path, shuffle):
+    base_tr, base_hist = _train(tmp_path, 1, shuffle)
+    sup_tr, sup_hist = _train(tmp_path, S, shuffle)
+    assert not base_tr._superstep_ready() and sup_tr._superstep_ready()
+    assert sup_tr._superstep_fns is not None  # the fused path actually ran
+    # coverage preconditions: full S-blocks AND a per-step remainder AND a
+    # padded tail batch (n_real < B) — all three paths exercised
+    batches = list(sup_tr.dataset.batches("train", BATCH, pad_last=True))
+    assert len(batches) // S >= 1 and len(batches) % S != 0
+    assert batches[-1].n_real < BATCH
+    np.testing.assert_array_equal(base_hist["train"], sup_hist["train"])
+    np.testing.assert_array_equal(base_hist["validate"], sup_hist["validate"])
+    _assert_same_state(base_tr, sup_tr)
+
+
+@pytest.mark.slow
+def test_streamed_data_falls_back_per_step(tmp_path):
+    """steps_per_superstep > 1 on the streaming path is inert: the gate
+    refuses (no resident pool to gather from) and results are unchanged."""
+    stream_tr, stream_hist = _train(tmp_path, 4, placement="stream")
+    base_tr, base_hist = _train(tmp_path, 1, placement="resident")
+    assert not stream_tr._superstep_ready()
+    assert stream_tr._superstep_fns is None  # never even built
+    np.testing.assert_array_equal(base_hist["train"], stream_hist["train"])
+    _assert_same_state(base_tr, stream_tr)
+
+
+def test_superstep_fns_match_looped_train_step():
+    """Unit-level: one jitted superstep == S sequential train_step calls
+    with host-side gathers, bit for bit (params, opt-state, every loss)."""
+    rng = np.random.default_rng(0)
+    m, n, t, b, s, pool = 2, 9, 5, 4, 3, 10
+    sup = jnp.asarray(rng.standard_normal((m, 3, n, n)).astype(np.float32) * 0.2)
+    model = STMGCN(m_graphs=m, n_supports=3, seq_len=t, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8)
+    x_all = jnp.asarray(rng.standard_normal((pool, t, n, 1)).astype(np.float32))
+    y_all = jnp.asarray(
+        rng.standard_normal((pool, n, 1)).astype(np.float32) * 0.1
+    )
+    optimizer = make_optimizer(1e-3, 1e-4)
+    fns = make_step_fns(model, optimizer, "mse")
+    sfns = make_superstep_fns(model, optimizer, "mse")
+    params, opt_state = fns.init(jax.random.key(0), sup, x_all[:b])
+    idx = rng.integers(0, pool, size=(s, b)).astype(np.int32)
+    mask = np.ones((s, b), np.float32)
+    mask[-1, -1] = 0.0  # a padded slot in the final microbatch
+
+    # independent copies: both jitted paths donate (params, opt_state)
+    p_ref = jax.tree.map(jnp.array, params)
+    s_ref = jax.tree.map(jnp.array, opt_state)
+    ref_losses = []
+    for i in range(s):
+        xb = jnp.take(x_all, jnp.asarray(idx[i]), axis=0)
+        yb = jnp.take(y_all, jnp.asarray(idx[i]), axis=0)
+        p_ref, s_ref, loss = fns.train_step(
+            p_ref, s_ref, sup, xb, yb, jnp.asarray(mask[i])
+        )
+        ref_losses.append(np.asarray(loss))
+
+    p_sup, s_sup, losses = sfns.train_superstep(
+        params, opt_state, sup, x_all, y_all, jnp.asarray(idx),
+        jnp.asarray(mask),
+    )
+    assert losses.shape == (s,)
+    np.testing.assert_array_equal(
+        np.asarray(losses), np.asarray(ref_losses, dtype=np.float32)
+    )
+    jax.tree.map(np.testing.assert_array_equal, p_sup, p_ref)
+    jax.tree.map(
+        np.testing.assert_array_equal,
+        jax.tree.leaves(s_sup), jax.tree.leaves(s_ref),
+    )
+
+
+def test_cli_and_config_plumbing():
+    from stmgcn_tpu.cli import build_parser, config_from_args
+
+    cfg = preset("smoke")
+    assert cfg.train.steps_per_superstep == 1  # default: per-step loop
+    args = build_parser().parse_args(
+        ["--preset", "smoke", "--steps-per-superstep", "4"]
+    )
+    assert config_from_args(args).train.steps_per_superstep == 4
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--steps-per-superstep", "0"])
+
+
+def test_trainer_rejects_nonpositive(tmp_path):
+    cfg = preset("smoke")
+    cfg.data.n_timesteps = 24 * 7 * 2 + 48
+    cfg.train.steps_per_superstep = 0
+    cfg.train.out_dir = str(tmp_path)
+    with pytest.raises(ValueError, match="steps_per_superstep"):
+        build_trainer(cfg, verbose=False)
+
+
+def test_gating_flags(tmp_path):
+    """The gate: resident + shared graphs + homogeneous model, S > 1."""
+    cfg = preset("smoke")
+    cfg.data.n_timesteps = 24 * 7 * 2 + 48
+    cfg.train.steps_per_superstep = 4
+    cfg.train.data_placement = "resident"
+    cfg.train.out_dir = str(tmp_path / "a")
+    assert build_trainer(cfg, verbose=False)._superstep_ready()
+
+    # per-city graphs (CitySupports) + heterogeneous cities: falls back
+    mc = preset("multicity")
+    mc.data.city_rows = (4, 3)
+    mc.data.city_timesteps = (24 * 7 * 2 + 24, 24 * 7 * 2)
+    mc.mesh.dp = 1
+    mc.train.steps_per_superstep = 4
+    mc.train.out_dir = str(tmp_path / "b")
+    assert not build_trainer(mc, verbose=False)._superstep_ready()

@@ -14,17 +14,20 @@ by tier-1 (``tests/test_analysis.py``):
   an unfenced span times *dispatch*, not compute), and train-step
   ``jax.jit`` calls missing ``donate_argnums``.
 - **Pass 2 — contract checks** (:mod:`.jaxpr_check`,
-  :mod:`.sharding_check`): abstractly trace the smoke-preset step
-  functions on CPU and assert jaxpr invariants (no silent fp64
-  promotions, no weak-type outputs that would recompile step 2, a
-  primitive-count budget guarding against fusion-breaking regressions),
-  plus static validation of every ``PartitionSpec`` literal against the
-  mesh axis names and the placement rank table.
+  :mod:`.sharding_check`, :mod:`.collective_check`): abstractly trace
+  the smoke-preset step functions on CPU and assert jaxpr invariants (no
+  silent fp64 promotions, no weak-type outputs that would recompile step
+  2, a primitive-count budget guarding against fusion-breaking
+  regressions), static validation of every ``PartitionSpec`` literal
+  against the mesh axis names and the placement rank table, and
+  collective-shape math for every multi-device preset (ppermute halo
+  rows vs shard size, batch vs dp, m_graphs vs branch).
 
 Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 ``# stmgcn: ignore``) on the offending line.
 """
 
+from stmgcn_tpu.analysis.collective_check import check_collective_contracts
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
 from stmgcn_tpu.analysis.report import Finding, render_json, render_text
@@ -35,6 +38,7 @@ __all__ = [
     "Finding",
     "RULES",
     "Rule",
+    "check_collective_contracts",
     "check_partition_specs",
     "check_step_contracts",
     "lint_package",

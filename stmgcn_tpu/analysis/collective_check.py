@@ -1,0 +1,146 @@
+"""Pass 2d: collective-shape contracts — static mesh/operand math.
+
+The sharded step programs move data through three collectives whose
+operand shapes are fully determined by the config: the ``ppermute`` halo
+exchange sends ``halo`` boundary rows per shard (:mod:`stmgcn_tpu.
+parallel.halo`), the data-parallel loss ``psum``/gather sees per-device
+batch slices, and branch model parallelism ``psum``s over equal branch
+shards. A config whose extents don't divide its operands fails only at
+runtime — on the mesh, possibly hours into a run (``strip_decompose``
+raises at decomposition time; GSPMD raggedness surfaces as a sharding
+error inside jit). This pass re-derives the shapes from the config alone
+— no data build, no trace — and flags the mismatches up front for every
+preset whose mesh spans more than one device.
+
+For the halo plan the check estimates the grid (neighborhood) branch's
+support bandwidth a priori: a rows x cols rook grid in row-major order
+has adjacency bandwidth ``cols``, and a K-hop kernel (``chebyshev`` /
+``random_walk_diffusion`` order K) reaches ``K * cols``; ``localpool``
+is one hop. Only the grid branch has such an a-priori bound — the
+transport/similarity branches' bandwidths are data-dependent, which is
+exactly why ``region_strategy="auto"`` routes them per-branch at
+decomposition time and why this check stays silent about them.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_collective_contracts", "grid_bandwidth_estimate"]
+
+_K_HOP_KERNELS = ("chebyshev", "random_walk_diffusion")
+
+
+def grid_bandwidth_estimate(kernel_type: str, K: int, cols: int) -> int:
+    """A-priori support bandwidth of the rook-grid branch.
+
+    Row-major rook adjacency has bandwidth ``cols`` (the vertical
+    neighbor); a K-hop kernel's highest-order support reaches K such
+    steps. ``localpool`` is the one-hop Kipf support.
+    """
+    hops = K if kernel_type in _K_HOP_KERNELS else 1
+    return hops * cols
+
+
+def _city_grids(cfg) -> List[Tuple[int, int]]:
+    """Every city's (rows, cols) synthetic grid shape."""
+    d = cfg.data
+    if d.city_rows is not None:
+        return [(r, r) for r in d.city_rows]
+    cols = d.cols if d.cols is not None else d.rows
+    return [(d.rows, cols)] * max(1, d.n_cities)
+
+
+def check_collective_contracts(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+) -> List[Finding]:
+    """Validate collective operand shapes against mesh extents.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset. Pure config math — safe without a JAX backend.
+    """
+    from stmgcn_tpu.config import PRESETS
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="collective-shape",
+                path=f"<contract:collective:{name}>",
+                line=0,
+                message=message,
+                severity=RULES["collective-shape"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        mesh = cfg.mesh
+        if mesh.n_devices <= 1:
+            continue
+
+        if mesh.dp > 1 and cfg.train.batch_size % mesh.dp:
+            emit(
+                name,
+                f"{name}: batch_size {cfg.train.batch_size} is not "
+                f"divisible by dp={mesh.dp} — the data-parallel loss "
+                "psum/gather would see ragged per-device batch shards",
+            )
+
+        if mesh.branch > 1 and cfg.model.m_graphs % mesh.branch:
+            emit(
+                name,
+                f"{name}: m_graphs {cfg.model.m_graphs} is not divisible "
+                f"by branch={mesh.branch} — the branch-sum psum needs "
+                "equal branch shards on every device",
+            )
+
+        halo_active = (
+            mesh.region > 1
+            and mesh.region_strategy in ("banded", "auto")
+            and not cfg.model.sparse
+        )
+        if not halo_active:
+            continue
+        for rows, cols in _city_grids(cfg):
+            n = rows * cols
+            padded = -(-n // mesh.region) * mesh.region
+            n_local = padded // mesh.region
+            budget = min(
+                mesh.halo if mesh.halo is not None else n_local // 2, n_local
+            )
+            if mesh.halo is not None and mesh.halo > n_local:
+                emit(
+                    name,
+                    f"{name}: mesh.halo {mesh.halo} exceeds the shard size "
+                    f"{n_local} ({padded} padded nodes / region="
+                    f"{mesh.region}) — the ppermute exchange operand "
+                    "cannot hold more rows than the shard",
+                )
+            bw = grid_bandwidth_estimate(
+                cfg.model.kernel_type, cfg.model.K, cols
+            )
+            if bw > n_local:
+                emit(
+                    name,
+                    f"{name}: grid-branch support bandwidth ~{bw} "
+                    f"({cfg.model.kernel_type} K={cfg.model.K} on a "
+                    f"{rows}x{cols} grid) exceeds the shard size {n_local} "
+                    "— no halo fits; shrink mesh.region or reorder nodes",
+                )
+            elif bw > budget and mesh.region_strategy == "banded":
+                emit(
+                    name,
+                    f"{name}: region_strategy='banded' but the grid "
+                    f"branch's support bandwidth ~{bw} exceeds the halo "
+                    f"budget {budget} (shard size {n_local}) — "
+                    "strip_decompose would drop boundary neighbors; use "
+                    "'auto' or raise mesh.halo",
+                )
+    return findings

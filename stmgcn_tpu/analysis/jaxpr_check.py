@@ -48,7 +48,7 @@ __all__ = [
 #: the count by seq_len), not single-op drift. Keep this a single-line
 #: literal: ``stmgcn lint --rebaseline`` rewrites it in place from the
 #: measured counts (:func:`rebaseline`).
-PRIMITIVE_BUDGETS = {"train_step": 860, "eval_step": 190, "train_superstep": 890}
+PRIMITIVE_BUDGETS = {"train_step": 860, "eval_step": 190, "train_superstep": 890, "train_step_checked": 3290}
 
 
 def _sub_jaxprs(params: dict):
@@ -146,6 +146,7 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     from stmgcn_tpu.config import preset
     from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
     from stmgcn_tpu.train import make_optimizer, make_step_fns, make_superstep_fns
+    from stmgcn_tpu.train.step import make_checked_raw_train_step
 
     cfg = preset(preset_name)
     dataset = build_dataset(cfg)
@@ -178,6 +179,14 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
         "train_superstep": jax.make_jaxpr(sfns.train_superstep)(
             params, opt_state, sup, x_all, y_all, idx_block, mask_block
         ),
+        # the checkify-wrapped step --checkify nan actually runs (the
+        # divergence-guard diagnostic path) — checked like the production
+        # programs so the debug tool cannot silently rot
+        "train_step_checked": jax.make_jaxpr(
+            make_checked_raw_train_step(
+                model, optimizer, loss=cfg.train.loss, checks="nan"
+            )
+        )(params, opt_state, sup, x, y, mask),
     }
 
 
@@ -185,7 +194,11 @@ def check_step_contracts(preset_name: str = "smoke") -> List[Finding]:
     """Trace the preset's step programs abstractly and check contracts."""
     findings: List[Finding] = []
     for name, closed in _trace_step_jaxprs(preset_name).items():
-        findings += _check_one(name, closed, True, PRIMITIVE_BUDGETS.get(name))
+        # checkify's error-payload outputs are weak-typed by construction
+        # and never feed back into the step inputs, so the weak-type
+        # contract does not apply to the checked program
+        strong = name != "train_step_checked"
+        findings += _check_one(name, closed, strong, PRIMITIVE_BUDGETS.get(name))
     return findings
 
 

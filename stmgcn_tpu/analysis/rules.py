@@ -88,6 +88,13 @@ _ALL_RULES = [
         "or op-count regression (rebaseline deliberately if intended)",
     ),
     Rule(
+        "collective-shape",
+        "error",
+        "a preset's mesh extents and collective operand shapes disagree "
+        "(ppermute halo rows vs shard size, batch vs dp, m_graphs vs "
+        "branch) — the collective fails or drops data at runtime",
+    ),
+    Rule(
         "partition-axis-name",
         "error",
         "PartitionSpec names a mesh axis that no mesh in this repo defines "

@@ -146,8 +146,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "costs a device sync per step")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
-    p.add_argument("--resume", action="store_true",
-                   help="resume from <out-dir>/latest.ckpt before training")
+    p.add_argument("--resume", nargs="?", const="strict", default=None,
+                   choices=("strict", "auto"),
+                   help="resume before training from the newest *verified* "
+                        "checkpoint in <out-dir> (latest -> rotated previous "
+                        "-> best snapshots; corrupt files are quarantined). "
+                        "Bare --resume errors when nothing resumable exists; "
+                        "--resume auto starts fresh instead (preemptible-job "
+                        "restart loops). Mid-epoch checkpoints continue "
+                        "bit-exactly from the step they were written at")
+    p.add_argument("--checkpoint-every-steps", type=int, default=None,
+                   metavar="K",
+                   help="additionally rewrite latest.ckpt every K optimizer "
+                        "steps with the exact mid-epoch resume cursor "
+                        "(default 0: epoch boundaries only)")
+    p.add_argument("--divergence-guard", action="store_true", default=None,
+                   help="check each step's loss for NaN/Inf; on a trip, roll "
+                        "params/optimizer back to the pre-step snapshot and "
+                        "skip (or defer) the batch. Costs a device sync per "
+                        "step")
+    p.add_argument("--divergence-action", choices=("skip", "defer"),
+                   default=None,
+                   help="what the guard does with an offending batch: drop "
+                        "it (skip) or retry it once at epoch end (defer)")
+    p.add_argument("--divergence-patience", type=_positive_int, default=None,
+                   help="abort after this many consecutive guard trips "
+                        "(default 3) — persistent divergence is not a "
+                        "single bad batch; see --checkify nan")
+    p.add_argument("--divergence-lr-cut", type=float, default=None,
+                   metavar="F",
+                   help="multiply the learning rate by F in (0,1) on each "
+                        "guard trip")
     p.add_argument("--export", type=str, default=None, metavar="PATH",
                    help="after training/testing, write the best checkpoint "
                         "as a self-contained AOT serving artifact "
@@ -194,12 +223,18 @@ def config_from_args(args) -> "ExperimentConfig":
         ("checks", "checks"),
         ("out_dir", "out_dir"), ("data_placement", "data_placement"),
         ("steps_per_superstep", "steps_per_superstep"),
+        ("checkpoint_every_steps", "checkpoint_every_steps"),
+        ("divergence_action", "divergence_action"),
+        ("divergence_patience", "divergence_patience"),
+        ("divergence_lr_cut", "divergence_lr_cut"),
     ]:
         val = getattr(args, field)
         if val is not None:
             setattr(cfg.train, attr, val)
     if args.shuffle:
         cfg.train.shuffle = True
+    if args.divergence_guard:
+        cfg.train.divergence_guard = True
     if args.m_graphs is not None:
         cfg.model.m_graphs = args.m_graphs
     if args.kernel is not None:
@@ -272,8 +307,19 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(f"error: {e.filename or e} not found", file=sys.stderr)
         return 1
+    from stmgcn_tpu.resilience import Preempted
+
     try:
-        if args.resume:
+        if args.resume == "auto":
+            # resume-if-possible: the restart-loop mode for preemptible
+            # jobs — an empty/corrupt-beyond-recovery out_dir starts fresh
+            meta = trainer.restore_auto()
+            if meta is None:
+                print("No resumable checkpoint found — starting fresh")
+            else:
+                print(f"Resumed from epoch {meta['epoch']} "
+                      f"(best val {meta['best_val']:.5})")
+        elif args.resume:
             meta = trainer.restore()
             print(f"Resumed from epoch {meta['epoch']} (best val {meta['best_val']:.5})")
         import contextlib
@@ -288,6 +334,11 @@ def main(argv=None) -> int:
             results = trainer.test(modes=("train", "test"))
         if args.profile:
             print(f"profiler trace written to {args.profile}")
+    except Preempted as e:
+        # the emergency checkpoint already landed; exit with SIGTERM's
+        # conventional code so supervisors treat it as a clean preemption
+        print(f"preempted: {e}", file=sys.stderr)
+        return 143
     except FileNotFoundError as e:
         print(f"error: {e.filename or e} not found"
               + (" — train first or check --out-dir" if args.test_only or args.resume else ""),

@@ -186,6 +186,18 @@ class TrainConfig:
     #: the device->host snapshot — stays on the training thread; reads
     #: flush pending writes first)
     async_checkpoint: bool = True
+    #: additionally rewrite latest.ckpt every K optimizer steps (0 = only
+    #: at epoch boundaries); mid-epoch writes carry the exact resume
+    #: cursor so --resume auto continues bit-exactly from step k
+    checkpoint_every_steps: int = 0
+    #: check each step's loss for non-finiteness; on a trip, roll
+    #: params/opt_state back to the pre-step snapshot and skip/defer the
+    #: batch (costs a device sync per step — off by default)
+    divergence_guard: bool = False
+    divergence_action: str = "skip"  # "skip" | "defer" (retry at epoch end)
+    divergence_patience: int = 3  # consecutive trips before aborting
+    #: multiply the learning rate by this factor on each trip (None = off)
+    divergence_lr_cut: Optional[float] = None
     seed: int = 0
     out_dir: str = "output"
 

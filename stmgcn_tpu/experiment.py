@@ -365,12 +365,17 @@ def build_trainer(
     cfg: ExperimentConfig,
     placement=None,
     verbose: bool = True,
+    fault_plan=None,
 ) -> Trainer:
     """Assemble a trainer; a >1-device mesh config gets sharded placement.
 
     If the config asks for a mesh and fewer devices are visible, this
     raises — silent fallback to one device would misreport the benchmark
     configs (3/4) as sharded.
+
+    ``fault_plan`` (a :class:`~stmgcn_tpu.resilience.FaultPlan`) threads
+    deterministic fault injection through the trainer's hot loop — the
+    fault-drill tests' entry point; ``None`` is the no-op production plan.
     """
     if placement is None and cfg.mesh.n_devices > 1:
         # Fail fast (before data/support construction) if the mesh can't exist.
@@ -454,6 +459,12 @@ def build_trainer(
         data_placement=t.data_placement,
         steps_per_superstep=t.steps_per_superstep,
         async_checkpoint=t.async_checkpoint,
+        checkpoint_every_steps=t.checkpoint_every_steps,
+        divergence_guard=t.divergence_guard,
+        divergence_action=t.divergence_action,
+        divergence_patience=t.divergence_patience,
+        divergence_lr_cut=t.divergence_lr_cut,
+        fault_plan=fault_plan,
         shuffle=t.shuffle,
         seed=t.seed,
         out_dir=t.out_dir,

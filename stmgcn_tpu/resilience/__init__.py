@@ -1,0 +1,41 @@
+"""Preemption-safe training: fault injection, divergence rollback.
+
+Training on preemptible TPU slices means workers die mid-epoch, disks
+truncate files, and one bad batch can NaN the params hours in. This
+package holds the pieces the trainer threads through its hot loop —
+behind no-op defaults, so the production code paths are exactly the
+tested paths:
+
+- :class:`FaultPlan` / :class:`FaultSpec` (:mod:`.faults`) — a
+  deterministic fault-injection harness: raise in the step, deliver
+  SIGTERM, poison a batch's loss mask with NaN/Inf, drop a batch, or
+  truncate/bit-flip a checkpoint write, each at a configured
+  (epoch, step) index or write ordinal. Every resilience claim in the
+  test suite is driven through it, not reproduced anecdotally.
+- :class:`DivergenceGuard` (:mod:`.guard`) — non-finite-loss detection
+  with rollback to an in-memory last-good snapshot, skip/defer of the
+  offending batch, optional LR cut, and abort after N consecutive trips.
+- :class:`Preempted` — raised at a safe step boundary after SIGTERM once
+  the emergency checkpoint has landed; a ``BaseException`` so broad
+  ``except Exception`` recovery code cannot swallow a shutdown request.
+
+The verified-checkpoint side (CRC32 format v2, ``load_latest_verified``
+recovery chain) lives in :mod:`stmgcn_tpu.train.checkpoint`.
+"""
+
+from stmgcn_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    Preempted,
+)
+from stmgcn_tpu.resilience.guard import DivergenceError, DivergenceGuard
+
+__all__ = [
+    "DivergenceError",
+    "DivergenceGuard",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "Preempted",
+]

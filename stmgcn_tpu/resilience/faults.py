@@ -1,0 +1,206 @@
+"""Deterministic fault injection for the training loop.
+
+A :class:`FaultPlan` is a set of :class:`FaultSpec` triggers the trainer
+consults at fixed points of its hot loop — before each step (or fused
+S-step block), when building each batch's loss mask, and when handing
+serialized checkpoint bytes to the writer. The empty plan is the
+default and every hook returns immediately, so production runs exercise
+*exactly* the code paths the fault drills test; there is no
+"instrumented build".
+
+Step faults address batches by ``(epoch, step)`` where ``step`` is the
+0-based ordinal of the batch **within its epoch, counting consumed
+batches** (guard-skipped and dropped batches advance it, like the resume
+cursor in checkpoint meta). This makes triggers reproducible across the
+per-step and superstep paths and across a divergence-guard rollback
+re-run: the re-run revisits the same ordinals, so a ``poison`` fault
+re-fires on exactly the batch it poisoned before (``poison``/``drop``
+are pure matches; ``raise``/``sigterm``/write faults fire once).
+
+Write faults address checkpoint writes by filename glob + ordinal among
+the matching writes, and corrupt the serialized bytes *before* they
+reach the atomic writer — simulating disk-level truncation/bit rot of a
+file that did land, the case ``os.replace`` atomicity cannot cover.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+import signal
+from typing import Optional, Tuple
+
+__all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "Preempted"]
+
+_STEP_KINDS = ("raise", "sigterm", "poison", "drop")
+_WRITE_KINDS = ("truncate-write", "corrupt-write")
+KINDS = _STEP_KINDS + _WRITE_KINDS
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``kind="raise"`` fault — a stand-in for the step fn
+    dying mid-epoch (driver crash, XLA error, host OOM)."""
+
+
+class Preempted(BaseException):
+    """SIGTERM was delivered and the emergency checkpoint has landed.
+
+    Deliberately a ``BaseException`` (like ``KeyboardInterrupt``): broad
+    ``except Exception`` retry/recovery code must not swallow a shutdown
+    request — the process has been asked to die and should exit after
+    unwinding. ``--resume auto`` continues the run bit-exactly.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One deterministic trigger in a :class:`FaultPlan`.
+
+    Step kinds (addressed by ``epoch``/``step``):
+
+    - ``"raise"``    — raise :class:`InjectedFault` before the step runs.
+    - ``"sigterm"``  — deliver SIGTERM to this process before the step
+      (``signal.raise_signal``): exercises the trainer's grace-window
+      handler, emergency checkpoint, and :class:`Preempted` unwind.
+    - ``"poison"``   — inject ``payload`` (default NaN) into the batch's
+      loss mask: the loss and every gradient go non-finite exactly as
+      they would for NaN input data, tripping checkify/the divergence
+      guard at that one step.
+    - ``"drop"``     — consume the batch without stepping. The control
+      for divergence drills: a guard-skip run must end bit-identical to
+      a drop run that never saw the poisoned batch.
+
+    Write kinds (addressed by ``path_glob``/``write_index``):
+
+    - ``"truncate-write"`` — keep only the first ``keep_fraction`` of the
+      serialized bytes.
+    - ``"corrupt-write"``  — flip one bit of byte ``flip_byte``
+      (-1 = middle of the file).
+    """
+
+    kind: str
+    epoch: Optional[int] = None  # step faults: epoch to fire in (None = any)
+    step: Optional[int] = None  # step faults: batch ordinal in the epoch
+    payload: float = float("nan")
+    path_glob: str = "*.ckpt"
+    write_index: int = 0
+    keep_fraction: float = 0.5
+    flip_byte: int = -1
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"fault kind must be one of {KINDS}, got {self.kind!r}")
+        if self.kind in ("poison", "drop") and self.step is None:
+            raise ValueError(f"{self.kind!r} faults need an explicit step ordinal")
+        if not 0.0 < self.keep_fraction < 1.0:
+            raise ValueError(
+                f"keep_fraction must be in (0, 1), got {self.keep_fraction}"
+            )
+
+    def _matches_step(self, epoch: int, start: int, stop: int) -> bool:
+        if self.epoch is not None and self.epoch != epoch:
+            return False
+        step = self.step if self.step is not None else start
+        return start <= step < stop
+
+
+class FaultPlan:
+    """A deterministic set of faults, consulted by the trainer's hot loop.
+
+    The empty plan (``FaultPlan()``) is the production default: every
+    hook short-circuits on ``self.specs`` being empty. One-shot state
+    (which ``raise``/``sigterm``/write faults already fired, per-glob
+    write counters) lives on the plan instance, so reusing a plan across
+    trainers re-arms it only if you build a fresh plan.
+    """
+
+    def __init__(self, *specs: FaultSpec):
+        if len(specs) == 1 and not isinstance(specs[0], FaultSpec):
+            specs = tuple(specs[0])  # accept FaultPlan([spec, ...])
+        for s in specs:
+            if not isinstance(s, FaultSpec):
+                raise TypeError(f"FaultPlan takes FaultSpecs, got {type(s).__name__}")
+        self.specs: Tuple[FaultSpec, ...] = tuple(specs)
+        self._fired: set = set()
+        self._write_counts: dict = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self.specs)
+
+    def before_step(self, epoch: int, start: int, stop: Optional[int] = None) -> None:
+        """Fire any one-shot ``raise``/``sigterm`` fault addressed to a
+        batch ordinal in ``[start, stop)`` of ``epoch`` (a superstep block
+        passes its full range: the fault lands at the block boundary, the
+        same safe point the emergency checkpoint uses)."""
+        if not self.specs:
+            return
+        stop = start + 1 if stop is None else stop
+        for i, spec in enumerate(self.specs):
+            if spec.kind not in ("raise", "sigterm"):
+                continue
+            key = ("step", i)
+            if key in self._fired or not spec._matches_step(epoch, start, stop):
+                continue
+            self._fired.add(key)
+            if spec.kind == "sigterm":
+                signal.raise_signal(signal.SIGTERM)
+            else:
+                raise InjectedFault(
+                    f"injected fault at epoch {epoch}, step {spec.step}"
+                )
+
+    def poison_value(self, epoch: int, step: int) -> Optional[float]:
+        """The NaN/Inf payload to inject at this batch, or ``None``.
+
+        A pure match (no one-shot state): a rollback re-run that revisits
+        this ordinal must poison it again, or the re-run would train on a
+        batch the original pass skipped.
+        """
+        for spec in self.specs:
+            if spec.kind == "poison" and spec._matches_step(epoch, step, step + 1):
+                return spec.payload
+        return None
+
+    def should_drop(self, epoch: int, step: int) -> bool:
+        """Whether this batch is consumed without an optimizer step."""
+        return any(
+            spec.kind == "drop" and spec._matches_step(epoch, step, step + 1)
+            for spec in self.specs
+        )
+
+    def any_drop(self, epoch: int, start: int, stop: int) -> bool:
+        """Whether any ordinal in ``[start, stop)`` carries a drop fault —
+        a fused block containing one falls back to the per-step path."""
+        return any(
+            spec.kind == "drop" and spec._matches_step(epoch, start, stop)
+            for spec in self.specs
+        )
+
+    def mutate_write(self, path: str, data: bytes) -> bytes:
+        """Corrupt checkpoint bytes bound for ``path`` per any matching
+        one-shot write fault (counted per spec over writes whose basename
+        matches its glob)."""
+        if not self.specs:
+            return data
+        name = os.path.basename(path)
+        for i, spec in enumerate(self.specs):
+            if spec.kind not in _WRITE_KINDS:
+                continue
+            if not fnmatch.fnmatch(name, spec.path_glob):
+                continue
+            key = ("write", i)
+            count = self._write_counts.get(key, 0)
+            self._write_counts[key] = count + 1
+            if count != spec.write_index or key in self._fired:
+                continue
+            self._fired.add(key)
+            if spec.kind == "truncate-write":
+                data = data[: max(1, int(len(data) * spec.keep_fraction))]
+            else:
+                idx = spec.flip_byte if spec.flip_byte >= 0 else len(data) // 2
+                mutated = bytearray(data)
+                mutated[idx] ^= 0x01
+                data = bytes(mutated)
+        return data

@@ -8,7 +8,13 @@ normalizer statistics), and the best-on-validation / patience early-stop
 semantics match the reference exactly (``Model_Trainer.py:47-60``).
 """
 
-from stmgcn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+from stmgcn_tpu.train.checkpoint import (
+    CorruptCheckpointError,
+    load_checkpoint,
+    load_latest_verified,
+    save_checkpoint,
+    verify_checkpoint,
+)
 from stmgcn_tpu.train.metrics import MAE, MAPE, MSE, PCC, RMSE, regression_report
 from stmgcn_tpu.train.step import (
     StepFns,
@@ -21,6 +27,7 @@ from stmgcn_tpu.train.trainer import CitySupports, Trainer
 
 __all__ = [
     "CitySupports",
+    "CorruptCheckpointError",
     "MAE",
     "MAPE",
     "MSE",
@@ -30,9 +37,11 @@ __all__ = [
     "SuperstepFns",
     "Trainer",
     "load_checkpoint",
+    "load_latest_verified",
     "make_optimizer",
     "make_step_fns",
     "make_superstep_fns",
     "regression_report",
     "save_checkpoint",
+    "verify_checkpoint",
 ]

@@ -1,4 +1,4 @@
-"""Self-sufficient single-file checkpoints with atomic writes.
+"""Self-sufficient single-file checkpoints with atomic, verified writes.
 
 The reference saves only ``{'epoch', 'state_dict'}`` on validation
 improvement (``Model_Trainer.py:18,52-53``): optimizer state is lost (no
@@ -8,32 +8,67 @@ loader object, so its checkpoints cannot even denormalize predictions
 needs: model params, optimizer state, and a JSON meta block (step/epoch,
 best validation loss, early-stop counter, normalizer statistics, config).
 
-Format: three length-prefixed blobs — JSON meta, flax-serialized params,
-flax-serialized optimizer state — written to a temp file and ``os.replace``d
-so a preemption mid-write never corrupts the previous checkpoint.
+Format v2 (``STMG2\\n``): three blobs — JSON meta, flax-serialized params,
+flax-serialized optimizer state — each preceded by a ``<QI`` header
+(length, CRC32). Files are written to a temp file and ``os.replace``d so a
+preemption mid-write never corrupts the previous checkpoint; the CRCs
+catch what atomic rename cannot — disk-level truncation or bit rot of a
+file that *did* land. v1 files (``STMG1\\n``, length-prefixed blobs, no
+CRC) remain readable.
+
+Every read path verifies structure: a header or blob that comes back
+short of its declared length raises :class:`CorruptCheckpointError`
+naming the path and the blob, never a garbage pytree or a confusing
+msgpack error. :func:`load_latest_verified` turns that into a recovery
+chain for ``--resume auto``: latest -> rotated previous latest -> best-k
+snapshots (newest first) -> best, quarantining each corrupt candidate as
+``<name>.corrupt`` with a logged reason.
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import json
 import os
+import re
 import struct
-from typing import Any, Optional
+import zlib
+from typing import Any, Callable, Optional
 
 from flax import serialization
 
 __all__ = [
+    "CorruptCheckpointError",
+    "FORMAT_VERSION",
     "load_checkpoint",
+    "load_latest_verified",
     "save_checkpoint",
     "serialize_checkpoint",
+    "verify_checkpoint",
     "write_checkpoint_bytes",
 ]
 
-_MAGIC = b"STMG1\n"
+_MAGIC_V1 = b"STMG1\n"
+_MAGIC_V2 = b"STMG2\n"
+#: current on-disk format: v2 = per-blob CRC32 (v1 files stay readable)
+FORMAT_VERSION = 2
+_BLOB_NAMES = ("meta", "params", "opt_state")
+#: v2 per-blob header: little-endian (length: u64, crc32: u32)
+_HEADER_V2 = struct.Struct("<QI")
+_LEN_V1 = struct.Struct("<Q")
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint file failed structural or CRC verification.
+
+    Raised instead of handing back garbage blobs: short reads (truncated
+    file), CRC mismatches (bit rot), unknown magic on a file that claims
+    to be a checkpoint. The message names the path and the failing blob.
+    """
 
 
 def serialize_checkpoint(params: Any, opt_state: Any, meta: dict) -> bytes:
-    """Snapshot state into one self-contained byte string.
+    """Snapshot state into one self-contained byte string (format v2).
 
     This is the device→host boundary: ``to_bytes`` materializes every leaf
     to host numpy, so the returned blob is immune to later in-place updates
@@ -45,9 +80,9 @@ def serialize_checkpoint(params: Any, opt_state: Any, meta: dict) -> bytes:
         serialization.to_bytes(params),
         serialization.to_bytes(opt_state),
     ]
-    out = [_MAGIC]
+    out = [_MAGIC_V2]
     for blob in blobs:
-        out.append(struct.pack("<Q", len(blob)))
+        out.append(_HEADER_V2.pack(len(blob), zlib.crc32(blob)))
         out.append(blob)
     return b"".join(out)
 
@@ -66,6 +101,77 @@ def save_checkpoint(path: str, params: Any, opt_state: Any, meta: dict) -> None:
     write_checkpoint_bytes(path, serialize_checkpoint(params, opt_state, meta))
 
 
+def _read_exact(f, n: int, path: str, what: str) -> bytes:
+    """``f.read(n)`` that refuses to come back short.
+
+    A truncated file yields fewer bytes than the header promised; without
+    this check the garbage propagates into flax's msgpack decoder (or
+    silently into the params) — the short-read bug this PR's issue names.
+    """
+    data = f.read(n)
+    if len(data) != n:
+        raise CorruptCheckpointError(
+            f"{path}: short read in {what} — wanted {n} bytes, file had "
+            f"{len(data)} (truncated checkpoint?)"
+        )
+    return data
+
+
+def _read_blobs(path: str, *, skip_opt_state: bool = False, verify_crc: bool = True):
+    """Read (version, [meta_bytes, params_bytes, opt_bytes|None]).
+
+    Structural verification happens here for both formats: every length is
+    checked against what the file actually holds, and (v2) every blob's
+    CRC32 against its header. ``skip_opt_state`` avoids *decoding* cost
+    upstream but still verifies the final blob's extent (and, for v2 with
+    ``verify_crc``, its checksum — the inference cold-start path keeps the
+    cheap variant by passing ``verify_crc=False``).
+    """
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        magic = f.read(len(_MAGIC_V2))
+        if magic == _MAGIC_V2:
+            version = 2
+        elif magic == _MAGIC_V1:
+            version = 1
+        else:
+            raise ValueError(f"{path} is not a stmgcn-tpu checkpoint")
+        header = _HEADER_V2 if version == 2 else _LEN_V1
+        blobs = []
+        for name in _BLOB_NAMES:
+            raw = _read_exact(f, header.size, path, f"{name} header")
+            if version == 2:
+                length, crc = header.unpack(raw)
+            else:
+                (length,) = header.unpack(raw)
+                crc = None
+            if f.tell() + length > size:
+                raise CorruptCheckpointError(
+                    f"{path}: {name} blob declares {length} bytes but only "
+                    f"{size - f.tell()} remain (truncated checkpoint?)"
+                )
+            if name == "opt_state" and skip_opt_state and not (
+                version == 2 and verify_crc
+            ):
+                blobs.append(None)
+                f.seek(length, os.SEEK_CUR)
+            else:
+                blob = _read_exact(f, length, path, f"{name} blob")
+                if crc is not None and verify_crc and zlib.crc32(blob) != crc:
+                    raise CorruptCheckpointError(
+                        f"{path}: CRC32 mismatch in {name} blob — expected "
+                        f"{crc:#010x}, got {zlib.crc32(blob):#010x} "
+                        "(bit rot or partial overwrite)"
+                    )
+                blobs.append(None if name == "opt_state" and skip_opt_state else blob)
+        if version == 2 and f.tell() != size:
+            raise CorruptCheckpointError(
+                f"{path}: {size - f.tell()} trailing bytes after the "
+                "opt_state blob (corrupt or mixed-up file)"
+            )
+    return version, blobs
+
+
 def load_checkpoint(
     path: str,
     params_template: Optional[Any] = None,
@@ -73,25 +179,22 @@ def load_checkpoint(
     *,
     load_opt_state: bool = True,
 ) -> tuple[dict, Any, Any]:
-    """Read ``(meta, params, opt_state)`` back.
+    """Read ``(meta, params, opt_state)`` back, verifying as it goes.
 
     With templates (the freshly-initialized structures), the exact pytree
     types are restored; without, params/opt_state come back as plain nested
     dicts — sufficient for ``model.apply`` at inference.
     ``load_opt_state=False`` skips deserializing the optimizer blob
     (~2x the parameter bytes) and returns ``None`` for it — the inference
-    cold-start path.
+    cold-start path (its extent is still verified; its CRC is not, to keep
+    the cheap variant cheap).
+
+    Truncated files, short reads, and (v2) CRC mismatches raise
+    :class:`CorruptCheckpointError` naming the failing blob.
     """
-    with open(path, "rb") as f:
-        if f.read(len(_MAGIC)) != _MAGIC:
-            raise ValueError(f"{path} is not a stmgcn-tpu checkpoint")
-        blobs = []
-        for i in range(3):
-            (length,) = struct.unpack("<Q", f.read(8))
-            if i == 2 and not load_opt_state:
-                blobs.append(None)
-                break
-            blobs.append(f.read(length))
+    _, blobs = _read_blobs(
+        path, skip_opt_state=not load_opt_state, verify_crc=load_opt_state
+    )
     meta = json.loads(blobs[0].decode("utf-8"))
     if params_template is not None:
         params = serialization.from_bytes(params_template, blobs[1])
@@ -104,3 +207,87 @@ def load_checkpoint(
     else:
         opt_state = serialization.msgpack_restore(blobs[2])
     return meta, params, opt_state
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Structurally verify a checkpoint and return its (parsed) meta.
+
+    Checks magic, every blob extent against the file size, and (v2) every
+    blob's CRC32 — without paying flax deserialization. Raises
+    :class:`CorruptCheckpointError` (or ``ValueError`` for a non-checkpoint
+    file) on any violation; a return means the file's bytes are intact.
+    """
+    _, blobs = _read_blobs(path)
+    try:
+        return json.loads(blobs[0].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CorruptCheckpointError(f"{path}: meta blob is not JSON: {e}") from e
+
+
+def _resume_candidates(out_dir: str) -> list[str]:
+    """Recovery order: latest -> rotated previous latest -> best-k
+    (newest epoch first) -> best."""
+    paths = []
+    for name in ("latest.ckpt", "latest.prev.ckpt"):
+        p = os.path.join(out_dir, name)
+        if os.path.exists(p):
+            paths.append(p)
+    bests = []
+    for p in _glob.glob(os.path.join(out_dir, "best_e*.ckpt")):
+        m = re.fullmatch(r"best_e(\d+)\.ckpt", os.path.basename(p))
+        if m:
+            bests.append((int(m.group(1)), p))
+    paths.extend(p for _, p in sorted(bests, reverse=True))
+    best = os.path.join(out_dir, "best.ckpt")
+    if os.path.exists(best):
+        paths.append(best)
+    return paths
+
+
+def load_latest_verified(
+    out_dir: str,
+    params_template: Optional[Any] = None,
+    opt_state_template: Optional[Any] = None,
+    *,
+    load_opt_state: bool = True,
+    quarantine: bool = True,
+    log: Optional[Callable[[str], None]] = None,
+) -> Optional[tuple[str, dict, Any, Any]]:
+    """The newest checkpoint in ``out_dir`` that passes verification.
+
+    Walks the recovery chain latest.ckpt -> latest.prev.ckpt (rotated by
+    the trainer before each latest write) -> best_e*.ckpt (newest epoch
+    first) -> best.ckpt. Every candidate is CRC/structure-verified before
+    it is loaded; corrupt ones are never silently loaded — they are
+    renamed to ``<name>.corrupt`` (``quarantine=True``, so the next resume
+    does not trip over them again) with the reason sent to ``log``.
+
+    Returns ``(path, meta, params, opt_state)`` for the first verified
+    candidate, or ``None`` when the directory holds no loadable checkpoint
+    at all (the ``--resume auto`` fresh-start case). Template-mismatch
+    errors from flax (a *valid* file for a different model) propagate —
+    quarantining those would destroy good checkpoints.
+    """
+    for path in _resume_candidates(out_dir):
+        try:
+            verify_checkpoint(path)
+        except (ValueError, OSError) as e:  # CorruptCheckpointError is a ValueError
+            if quarantine:
+                quarantined = path + ".corrupt"
+                try:
+                    os.replace(path, quarantined)
+                except OSError:
+                    quarantined = "(rename failed; left in place)"
+                if log:
+                    log(
+                        f"checkpoint {path} failed verification "
+                        f"({e}) — quarantined as {quarantined}"
+                    )
+            elif log:
+                log(f"checkpoint {path} failed verification ({e}) — skipped")
+            continue
+        meta, params, opt_state = load_checkpoint(
+            path, params_template, opt_state_template, load_opt_state=load_opt_state
+        )
+        return path, meta, params, opt_state
+    return None

@@ -29,6 +29,7 @@ import optax
 __all__ = [
     "StepFns",
     "SuperstepFns",
+    "make_checked_raw_train_step",
     "make_optimizer",
     "make_step_fns",
     "make_superstep_fns",
@@ -148,6 +149,18 @@ class SuperstepFns:
 CHECK_SETS = ("nan", "index", "float", "all")
 
 
+def _error_set(checks: str):
+    """Resolve a :data:`CHECK_SETS` name to its checkify error set."""
+    from jax.experimental import checkify
+
+    return {
+        "nan": checkify.nan_checks,
+        "index": checkify.index_checks,
+        "float": checkify.float_checks,  # nan + div (no index checks)
+        "all": checkify.all_checks,
+    }[checks]
+
+
 def _raw_step_bodies(model, optimizer, loss: str):
     """The unjitted init/train/eval bodies shared by :func:`make_step_fns`
     and :func:`make_superstep_fns`.
@@ -237,12 +250,7 @@ def make_step_fns(
 
     from jax.experimental import checkify
 
-    errset = {
-        "nan": checkify.nan_checks,
-        "index": checkify.index_checks,
-        "float": checkify.float_checks,  # nan + div (no index checks)
-        "all": checkify.all_checks,
-    }[checks]
+    errset = _error_set(checks)
     ck_train = jax.jit(checkify.checkify(train_step, errors=errset), donate_argnums=(0, 1))
     ck_eval = jax.jit(checkify.checkify(eval_step, errors=errset))
 
@@ -257,6 +265,30 @@ def make_step_fns(
         return out
 
     return StepFns(init=jax.jit(init), train_step=checked_train, eval_step=checked_eval)
+
+
+def make_checked_raw_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    loss: str = "mse",
+    checks: str = "nan",
+):
+    """The *unjitted* checkify-wrapped train step, for abstract tracing.
+
+    This is exactly the program :func:`make_step_fns` jits when ``checks``
+    is set — ``checkify.checkify(train_step, errors=...)`` over the shared
+    raw body — exposed so the static-analysis contract pass can
+    ``jax.make_jaxpr`` it and budget its primitive count like the unchecked
+    programs (stmgcn_tpu/analysis/jaxpr_check.py). Returns a callable
+    ``(params, opt_state, supports, x, y, mask) -> (err, (params,
+    opt_state, loss))``.
+    """
+    if checks not in CHECK_SETS:
+        raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
+    from jax.experimental import checkify
+
+    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+    return checkify.checkify(train_step, errors=_error_set(checks))
 
 
 def make_superstep_fns(
@@ -319,14 +351,9 @@ def make_superstep_fns(
 
     from jax.experimental import checkify
 
-    errset = {
-        "nan": checkify.nan_checks,
-        "index": checkify.index_checks,
-        "float": checkify.float_checks,  # nan + div (no index checks)
-        "all": checkify.all_checks,
-    }[checks]
     ck = jax.jit(
-        checkify.checkify(train_superstep, errors=errset), donate_argnums=(0, 1)
+        checkify.checkify(train_superstep, errors=_error_set(checks)),
+        donate_argnums=(0, 1),
     )
 
     def checked_superstep(params, opt_state, supports, x_all, y_all, idx_block, mask_block):

@@ -22,6 +22,16 @@ with the same control semantics, restructured for JAX:
   (default) picks resident on a single device when the windowed arrays
   fit comfortably in HBM.
 
+Preemption safety (stmgcn_tpu/resilience): a ``FaultPlan`` threads
+deterministic fault injection through this loop behind a no-op default;
+SIGTERM gets a grace-window emergency checkpoint and a ``Preempted``
+unwind at the next safe step boundary; ``checkpoint_every_steps`` adds a
+mid-epoch ``latest`` cadence whose meta carries the exact resume cursor
+(batch-in-epoch, data-order state, partial epoch losses) so
+``restore_auto()`` continues bit-exactly from step k; an optional
+``DivergenceGuard`` rolls params/opt_state back to an in-memory last-good
+snapshot when a step's loss goes non-finite.
+
 Multi-host note: only the lead process touches ``out_dir`` — writes
 always, and in multi-process jobs reads too: ``restore()``/``test()``
 load the checkpoint on process 0 and **broadcast** the state (params,
@@ -33,8 +43,11 @@ should also see the files themselves.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
+import signal
+import threading
 import time
 from typing import Optional
 
@@ -43,8 +56,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from stmgcn_tpu.data.pipeline import DemandDataset
+from stmgcn_tpu.resilience.faults import FaultPlan, Preempted
+from stmgcn_tpu.resilience.guard import DivergenceGuard
 from stmgcn_tpu.train.checkpoint import (
     load_checkpoint,
+    load_latest_verified,
     serialize_checkpoint,
     write_checkpoint_bytes,
 )
@@ -152,6 +168,12 @@ class Trainer:
         data_placement: str = "auto",
         steps_per_superstep: int = 1,
         async_checkpoint: bool = True,
+        checkpoint_every_steps: int = 0,
+        divergence_guard: bool = False,
+        divergence_action: str = "skip",
+        divergence_patience: int = 3,
+        divergence_lr_cut: Optional[float] = None,
+        fault_plan: Optional[FaultPlan] = None,
         placement=None,
         extra_meta: Optional[dict] = None,
         verbose: bool = True,
@@ -209,6 +231,40 @@ class Trainer:
         #: anything else silently falls back to the per-step loop, which
         #: is bit-identical anyway.
         self.steps_per_superstep = steps_per_superstep
+        if checkpoint_every_steps < 0:
+            raise ValueError(
+                f"checkpoint_every_steps must be >= 0, got {checkpoint_every_steps}"
+            )
+        #: 0 = epoch-cadence latest writes only; K > 0 additionally rewrites
+        #: ``latest.ckpt`` every K optimizer steps, carrying the mid-epoch
+        #: resume cursor in its meta
+        self.checkpoint_every_steps = checkpoint_every_steps
+        #: deterministic fault injection (tests); the empty default plan
+        #: makes every hook a no-op, so this *is* the production code path
+        self.fault_plan = fault_plan if fault_plan is not None else FaultPlan()
+        self._guard = (
+            DivergenceGuard(
+                action=divergence_action,
+                patience=divergence_patience,
+                lr_cut=divergence_lr_cut,
+            )
+            if divergence_guard
+            else None
+        )
+        #: optimizer steps taken across the whole run (survives resume)
+        self.global_step = 0
+        # mid-epoch resume machinery: the cursor of consumed batches within
+        # the current epoch, the skip count a restored checkpoint asks for,
+        # and the partial per-batch loss/count accumulators the epoch
+        # reduction reads (persisted in mid-epoch checkpoint meta)
+        self._batch_in_epoch = 0
+        self._resume_skip = 0
+        self._epoch_losses: list = []
+        self._epoch_counts: list = []
+        self._deferred: list = []  # guard action="defer" end-of-epoch retries
+        self._preempted = False  # SIGTERM arrived; unwind at next safe point
+        self._last_cadence_step = 0
+        self._lr_scale = 1.0  # cumulative divergence-guard LR cut
         self._resident_cache: dict = {}
         #: serialize on the training thread (device->host snapshot), write
         #: the file from a background worker — IO leaves the epoch's
@@ -271,24 +327,34 @@ class Trainer:
         # counter lives in opt_state so --resume continues the schedule
         # where the checkpoint left it
         spe = self._train_steps_per_epoch()
-        optimizer = make_optimizer(
-            lr,
-            weight_decay,
-            schedule=lr_schedule,
-            warmup_steps=int(warmup_epochs * spe),
-            decay_steps=n_epochs * spe,
-            min_lr_fraction=min_lr_fraction,
-            grad_clip_norm=grad_clip_norm,
-        )
+
+        def _optimizer_factory(scale: float = 1.0):
+            return make_optimizer(
+                lr * scale,
+                weight_decay,
+                schedule=lr_schedule,
+                warmup_steps=int(warmup_epochs * spe),
+                decay_steps=n_epochs * spe,
+                min_lr_fraction=min_lr_fraction,
+                grad_clip_norm=grad_clip_norm,
+            )
+
+        # a factory rather than a bound optimizer: the divergence guard's
+        # lr_cut rebuilds the optimizer at a scaled base LR mid-run (the
+        # optax state structure is invariant to the scale, so the live
+        # opt_state stays valid); step-fn builders read self._optimizer at
+        # call time so rebuilt fns pick up the cut
+        self._optimizer_factory = _optimizer_factory
+        self._optimizer = _optimizer_factory()
 
         def _fresh_fns(mdl):
-            return make_step_fns(mdl, optimizer, loss, checks=checks)
+            return make_step_fns(mdl, self._optimizer, loss, checks=checks)
 
         self._make_fns = _fresh_fns
         self.step_fns = _fresh_fns(model)
         # built lazily on first superstep epoch — most trainers never need it
         self._make_superstep_fns = lambda: make_superstep_fns(
-            model, optimizer, loss, checks=checks
+            model, self._optimizer, loss, checks=checks
         )
         self._superstep_fns = None
         # Per-city gate pooling under per-city node padding: cities with
@@ -338,6 +404,10 @@ class Trainer:
     def latest_path(self) -> str:
         return os.path.join(self.out_dir, "latest.ckpt")
 
+    @property
+    def latest_prev_path(self) -> str:
+        return os.path.join(self.out_dir, "latest.prev.ckpt")
+
     # -- internals ------------------------------------------------------
     def _log(self, msg: str) -> None:
         if self.verbose and self.is_lead:
@@ -355,15 +425,31 @@ class Trainer:
         if not self.is_lead:
             return None
         data = serialize_checkpoint(self.params, self.opt_state, self._meta())
+        if path == self.latest_path:
+            # rotate before overwriting: if this write lands corrupt (disk
+            # full, bit rot), latest.prev.ckpt is the previous verified
+            # state and load_latest_verified falls back to it
+            self._rotate(path, self.latest_prev_path)
         self._write(path, data)
         return data
 
+    def _rotate(self, src: str, dst: str) -> None:
+        if self.async_checkpoint and self._write_queue is not None:
+            # FIFO with the write that follows, so the rename can never
+            # reorder past it and clobber the new file
+            self._write_queue.put(("rotate", src, dst))
+            return
+        try:
+            os.replace(src, dst)
+        except OSError:  # first write: no previous latest to rotate
+            pass
+
     def _write(self, path: str, data: bytes) -> None:
+        data = self.fault_plan.mutate_write(path, data)
         if not self.async_checkpoint:
             write_checkpoint_bytes(path, data)
             return
         import queue
-        import threading
 
         if self._writer is None:
             # Bounded: each entry holds a full serialized state blob, so an
@@ -376,10 +462,15 @@ class Trainer:
                     job = self._write_queue.get()
                     if job is None:
                         return
-                    op, path, data = job
+                    op, path, payload = job
                     try:
                         if op == "write":
-                            write_checkpoint_bytes(path, data)
+                            write_checkpoint_bytes(path, payload)
+                        elif op == "rotate":  # latest -> latest.prev
+                            try:
+                                os.replace(path, payload)
+                            except OSError:
+                                pass
                         else:  # "rm" — FIFO with writes, so a stale snapshot
                             try:  # cannot resurrect after its removal
                                 os.remove(path)
@@ -418,7 +509,30 @@ class Trainer:
             "patience_left": self.patience_left,
             "seed": self.seed,
             "kept": self._kept,  # top-k retention state survives resume
+            "global_step": self.global_step,
+            # mid-epoch resume cursor: consumed batches in the current
+            # epoch; 0 means "epoch boundary — resume at epoch+1"
+            "batch_in_epoch": self._batch_in_epoch,
+            # data order is recomputable from (seed, shuffle, epoch) alone;
+            # these pin it so resume refuses a mismatched data order
+            "shuffle": self.shuffle,
+            "steps_per_superstep": self.steps_per_superstep,
         }
+        if self._lr_scale != 1.0:
+            meta["lr_scale"] = self._lr_scale
+        if self._batch_in_epoch:
+            # partial-epoch loss accumulators so the resumed run's epoch
+            # reduction sees every batch; float() syncs each device scalar,
+            # a cost only mid-epoch saves pay (epoch-boundary saves have
+            # batch_in_epoch == 0 and skip this)
+            meta["partial"] = {
+                "losses": [
+                    float(v)
+                    for l in self._epoch_losses
+                    for v in np.asarray(l, np.float32).reshape(-1)
+                ],
+                "counts": [int(c) for c in self._epoch_counts],
+            }
         if getattr(self.dataset, "heterogeneous", False):
             meta["normalizers"] = [
                 n.to_dict() if n is not None else None
@@ -472,7 +586,12 @@ class Trainer:
         return self._city_fns[city]
 
     def _placed_batches(
-        self, mode: str, *, shuffle: bool = False, with_arrays: bool | None = None
+        self,
+        mode: str,
+        *,
+        shuffle: bool = False,
+        with_arrays: bool | None = None,
+        skip: int = 0,
     ):
         """Iterate ``(batch, (x, y, mask))`` with placement run ahead.
 
@@ -501,6 +620,9 @@ class Trainer:
             pad_last=True,
             with_arrays=with_arrays,
         ):
+            if skip:  # mid-epoch resume: already-consumed batches (the
+                skip -= 1  # deterministic (seed, epoch) order re-yields
+                continue  # them in the same positions) are not placed
             queue.append((batch, self._place_batch(batch, mode)))
             if len(queue) > self.prefetch:
                 yield queue.popleft()
@@ -587,29 +709,154 @@ class Trainer:
 
         Losses stay on device until the epoch ends — a per-batch
         ``float(loss)`` would fence the pipeline every step and serialize
-        host batch prep with device compute.
+        host batch prep with device compute. (The opt-in divergence guard
+        pays exactly that sync, which is why it is off by default.)
+
+        Training epochs accumulate into ``self._epoch_losses`` /
+        ``self._epoch_counts`` rather than locals: a mid-epoch checkpoint
+        persists them (``_meta``) and a mid-epoch resume re-enters here
+        with ``self._resume_skip`` batches already consumed, so the final
+        reduction still covers every batch of the epoch bit-exactly.
         """
-        if train and self._superstep_ready():
-            return self._run_epoch_superstep(mode)
-        losses, counts = [], []
-        for batch, (x, y, mask) in self._placed_batches(
-            mode, shuffle=self.shuffle and train
-        ):
-            sup = self._supports_for(batch)
-            fns = self._fns(batch.city)
-            if train:
-                self.params, self.opt_state, loss = fns.train_step(
-                    self.params, self.opt_state, sup, x, y, mask
+        if not train:
+            losses, counts = [], []
+            for batch, (x, y, mask) in self._placed_batches(mode):
+                loss, _ = self._fns(batch.city).eval_step(
+                    self.params, self._supports_for(batch), x, y, mask
                 )
-            else:
-                loss, _ = fns.eval_step(self.params, sup, x, y, mask)
-            losses.append(loss)
-            counts.append(batch.n_real)
-        if not counts:
+                losses.append(loss)
+                counts.append(batch.n_real)
+                self._check_preempt()
+            if not counts:
+                raise ValueError(f"no samples in mode {mode!r}")
+            weights = np.asarray(counts, dtype=np.float32)
+            weighted = jnp.stack(losses) @ jnp.asarray(weights)
+            return float(weighted) / float(weights.sum())
+
+        skip = self._resume_skip
+        self._resume_skip = 0
+        if skip == 0:
+            self._epoch_losses, self._epoch_counts = [], []
+        self._deferred = []
+        # resume points landing mid-remainder (skip % S != 0) take the
+        # per-step loop for the rest of the epoch — bit-identical to the
+        # superstep by the PR 2 parity contract, just unfused
+        if self._superstep_ready() and skip % self.steps_per_superstep == 0:
+            self._run_train_epoch_superstep(mode, skip)
+        else:
+            self._run_train_epoch_steps(mode, skip)
+        deferred, self._deferred = self._deferred, []
+        for batch in deferred:  # guard action="defer": one retry at epoch end
+            x, y, mask = self._place_batch(batch, mode)
+            self._train_one(batch, x, y, mask, retry=True)
+            self._after_train_batch()
+        if not self._epoch_counts:
             raise ValueError(f"no samples in mode {mode!r}")
-        weights = np.asarray(counts, dtype=np.float32)
-        weighted = jnp.stack(losses) @ jnp.asarray(weights)
-        return float(weighted) / float(weights.sum())
+        weights = np.asarray(self._epoch_counts, dtype=np.float32)
+        # scalars and (S,) superstep vectors interleave in epoch order;
+        # the flattened product is elementwise identical to the per-step
+        # loop's stack @ weights
+        vec = jnp.concatenate(
+            [jnp.atleast_1d(jnp.asarray(l)) for l in self._epoch_losses]
+        )
+        return float(vec @ jnp.asarray(weights)) / float(weights.sum())
+
+    def _run_train_epoch_steps(self, mode: str, skip: int) -> None:
+        for batch, (x, y, mask) in self._placed_batches(
+            mode, shuffle=self.shuffle, skip=skip
+        ):
+            self._train_one(batch, x, y, mask)
+            self._after_train_batch()
+
+    def _train_one(self, batch, x, y, mask, retry: bool = False) -> None:
+        """One optimizer step with the resilience hooks threaded through.
+
+        ``retry`` marks a deferred-batch re-run at epoch end: the fault
+        plan is not consulted (its ordinals addressed the first pass) and
+        the cursor does not advance (known limitation: deferred retries
+        are not mid-epoch-resume addressable; a guard trip on a retry
+        falls back to skip).
+        """
+        plan = self.fault_plan
+        step = self._batch_in_epoch
+        if not retry:
+            plan.before_step(self.epoch, step)
+            if plan.should_drop(self.epoch, step):
+                self._batch_in_epoch += 1
+                return
+            poison = plan.poison_value(self.epoch, step)
+            if poison is not None:
+                mask = mask.at[(0,) * mask.ndim].set(poison)
+        guard = self._guard
+        if guard is not None:
+            # donation invalidates the buffers we pass in — rollback needs
+            # real copies taken before dispatch
+            snapshot = (
+                jax.tree.map(jnp.copy, self.params),
+                jax.tree.map(jnp.copy, self.opt_state),
+            )
+        fns = self._fns(batch.city)
+        self.params, self.opt_state, loss = fns.train_step(
+            self.params, self.opt_state, self._supports_for(batch), x, y, mask
+        )
+        if not retry:
+            self._batch_in_epoch += 1
+        if guard is not None and not np.isfinite(float(loss)):
+            self.params, self.opt_state = snapshot
+            self._log(
+                f"divergence guard: non-finite loss at epoch {self.epoch}, "
+                f"step {step} — rolled back, {guard.action} batch"
+            )
+            if guard.lr_cut is not None:
+                self._set_lr_scale(self._lr_scale * guard.lr_cut)
+            guard.trip(float(loss), self.epoch, step)
+            if guard.action == "defer" and not retry:
+                self._deferred.append(batch)
+            return  # no loss/count recorded; global_step does not advance
+        if guard is not None:
+            guard.ok()
+        self.global_step += 1
+        self._epoch_losses.append(loss)
+        self._epoch_counts.append(batch.n_real)
+
+    def _after_train_batch(self) -> None:
+        """Step-cadence latest write + SIGTERM safe point, after every
+        consumed batch / fused block."""
+        K = self.checkpoint_every_steps
+        if K and self.global_step - self._last_cadence_step >= K:
+            self._save(self.latest_path)
+            self._last_cadence_step = self.global_step
+        self._check_preempt()
+
+    def _check_preempt(self) -> None:
+        """SIGTERM grace window: the in-flight step has finished, so write
+        the emergency checkpoint here (a safe boundary — the meta cursor is
+        consistent) and unwind with :class:`Preempted`."""
+        if not self._preempted:
+            return
+        self._log(
+            f"SIGTERM received — emergency checkpoint at epoch {self.epoch}, "
+            f"step {self.global_step}"
+        )
+        self._save(self.latest_path)
+        self.flush_checkpoints()
+        raise Preempted(
+            f"preempted at epoch {self.epoch}, step {self.global_step}; "
+            "restart with --resume auto to continue bit-exactly"
+        )
+
+    def _set_lr_scale(self, scale: float) -> None:
+        """Rebuild the optimizer at ``lr * scale`` (divergence lr_cut /
+        resume of a cut run). opt_state structure is scale-invariant, so
+        the live state carries over; step fns rebuild so their closures see
+        the new optimizer."""
+        if scale == self._lr_scale:
+            return
+        self._lr_scale = scale
+        self._optimizer = self._optimizer_factory(scale)
+        self.step_fns = self._make_fns(self.model)
+        self._superstep_fns = None
+        self._city_fns.clear()
 
     def _pack_blocks(self, batches, mode: str):
         """Stack index-only batches into (idx_block, mask_block, n_reals)
@@ -634,7 +881,7 @@ class Trainer:
             blocks.append((idx_block, mask_block, [b.n_real for b in chunk]))
         return blocks, batches[(len(batches) // S) * S:]
 
-    def _run_epoch_superstep(self, mode: str) -> float:
+    def _run_train_epoch_superstep(self, mode: str, skip: int) -> None:
         """Training epoch as fused S-step dispatches (module docstring;
         train/step.py ``make_superstep_fns``).
 
@@ -645,25 +892,65 @@ class Trainer:
         through the ordinary per-step path. Per-step losses come back in
         batch order, so the epoch loss reduction is elementwise identical
         to the per-step loop's.
+
+        Resilience hooks operate at block granularity: one-shot step
+        faults and the SIGTERM safe point land at block boundaries; a
+        block containing a drop fault, or one the divergence guard rolled
+        back, re-runs through the per-step path (bit-identical by the
+        parity contract), where poison faults re-fire per-microbatch and
+        the guard skips exactly the offending one.
         """
         if self._superstep_fns is None:
             self._superstep_fns = self._make_superstep_fns()
+        S = self.steps_per_superstep
         x_all, y_all = self._resident_arrays(mode, 0)
         sup = self.supports
         batches = list(self.dataset.batches(
             mode, self.batch_size, shuffle=self.shuffle, seed=self.seed,
             epoch=self.epoch, pad_last=True, with_arrays=False,
         ))
-        blocks, remainder = self._pack_blocks(batches, mode)
-        losses, counts = [], []
+        if skip > len(batches):
+            raise ValueError(
+                f"resume cursor {skip} exceeds the epoch's {len(batches)} "
+                "batches — checkpoint from a different data configuration?"
+            )
+        pending = batches[skip:]
+        blocks, remainder = self._pack_blocks(pending, mode)
+        plan, guard = self.fault_plan, self._guard
 
         def place(block):
             idx_np, mask_np, n_reals = block
             return jnp.asarray(idx_np), jnp.asarray(mask_np), n_reals
 
+        def per_step_block(i):
+            for batch in pending[i * S:(i + 1) * S]:
+                x, y, mask = self._place_batch(batch, mode)
+                self._train_one(batch, x, y, mask)
+                self._after_train_batch()
+
         placed = place(blocks[0]) if blocks else None
         for i in range(len(blocks)):
+            start = self._batch_in_epoch
+            plan.before_step(self.epoch, start, start + S)
+            if plan.active and plan.any_drop(self.epoch, start, start + S):
+                # a dropped microbatch breaks the fused block's uniform
+                # shape — run these S batches per-step instead
+                placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+                per_step_block(i)
+                continue
             idx_d, mask_d, n_reals = placed
+            if plan.active:
+                for s in range(S):
+                    poison = plan.poison_value(self.epoch, start + s)
+                    if poison is not None:
+                        mask_d = mask_d.at[
+                            (s,) + (0,) * (mask_d.ndim - 1)
+                        ].set(poison)
+            if guard is not None:
+                snapshot = (
+                    jax.tree.map(jnp.copy, self.params),
+                    jax.tree.map(jnp.copy, self.opt_state),
+                )
             self.params, self.opt_state, loss_vec = (
                 self._superstep_fns.train_superstep(
                     self.params, self.opt_state, sup, x_all, y_all, idx_d, mask_d
@@ -671,27 +958,53 @@ class Trainer:
             )
             # superstep i is dispatched; upload block i+1 under its compute
             placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
-            losses.append(loss_vec)  # (S,) — stays on device
-            counts.extend(n_reals)
+            if guard is not None and not np.isfinite(np.asarray(loss_vec)).all():
+                # a scanned step fed NaN forward into every later step of
+                # the block: roll the whole block back and replay it
+                # per-step, where the guard isolates the one bad microbatch
+                self.params, self.opt_state = snapshot
+                self._log(
+                    f"divergence guard: non-finite loss in superstep block "
+                    f"at epoch {self.epoch}, steps {start}..{start + S - 1} "
+                    "— rolled back, replaying per-step"
+                )
+                per_step_block(i)
+                continue
+            if guard is not None:
+                guard.ok()
+            self._batch_in_epoch += S
+            self.global_step += S
+            self._epoch_losses.append(loss_vec)  # (S,) — stays on device
+            self._epoch_counts.extend(n_reals)
+            self._after_train_batch()
         for batch in remainder:
             x, y, mask = self._place_batch(batch, mode)
-            self.params, self.opt_state, loss = self.step_fns.train_step(
-                self.params, self.opt_state, sup, x, y, mask
-            )
-            losses.append(jnp.atleast_1d(loss))
-            counts.append(batch.n_real)
-        if not counts:
-            raise ValueError(f"no samples in mode {mode!r}")
-        weights = np.asarray(counts, dtype=np.float32)
-        weighted = jnp.concatenate(losses) @ jnp.asarray(weights)
-        return float(weighted) / float(weights.sum())
+            self._train_one(batch, x, y, mask)
+            self._after_train_batch()
 
     # -- public API -----------------------------------------------------
     def train(self) -> dict:
-        """Run the epoch loop; returns the history dict."""
+        """Run the epoch loop; returns the history dict.
+
+        While training runs (main thread only — ``signal.signal`` is
+        unavailable elsewhere), SIGTERM is caught and deferred to the next
+        safe step boundary, where :meth:`_check_preempt` writes an
+        emergency checkpoint and raises :class:`Preempted`; the previous
+        handler is restored on the way out.
+        """
         history = {"train": [], "validate": []}
         self._log(f"Training starts at: {time.ctime()}")
-        start_epoch = self.epoch + 1
+        in_main = threading.current_thread() is threading.main_thread()
+        prev_handler = None
+        if in_main:
+
+            def _on_sigterm(signum, frame):
+                self._preempted = True
+
+            prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        # a mid-epoch resume cursor re-enters the checkpointed epoch to
+        # finish it; an epoch-boundary checkpoint starts the next one
+        start_epoch = self.epoch + (1 if self._resume_skip == 0 else 0)
         try:
             self._epoch_loop(history, start_epoch)
         except BaseException:
@@ -706,6 +1019,9 @@ class Trainer:
             except Exception as flush_exc:
                 self._log(f"checkpoint flush failed during teardown: {flush_exc}")
             raise
+        finally:
+            if in_main:
+                signal.signal(signal.SIGTERM, prev_handler)
         self.flush_checkpoints()
         self._log(f"Training ends at: {time.ctime()}")
         return history
@@ -715,7 +1031,18 @@ class Trainer:
             self.epoch = epoch
             t0 = time.time()
             train_loss = self._run_epoch("train", train=True)
+            self._check_preempt()
             val_loss = self._run_epoch("validate", train=False)
+            self._check_preempt()
+            # the epoch's batches are all consumed: zero the resume cursor
+            # *before* the bookkeeping saves below, so their meta points a
+            # resume at epoch+1. A preemption before this line instead
+            # saved cursor == steps-per-epoch: the resume re-enters this
+            # epoch with nothing left to train, recomputes the loss from
+            # the persisted partials, and redoes val + bookkeeping (which
+            # had not happened yet) exactly once.
+            self._batch_in_epoch = 0
+            self._epoch_losses, self._epoch_counts = [], []
             history["train"].append(train_loss)
             history["validate"].append(val_loss)
 
@@ -762,6 +1089,7 @@ class Trainer:
             if self.patience_left == 0:
                 self._log(f"Early stopping at epoch {epoch}..")
                 break
+            self._check_preempt()  # SIGTERM during bookkeeping
 
     def _load_state(self, path: str):
         """Read a checkpoint — on the lead process only in multi-host jobs,
@@ -803,20 +1131,145 @@ class Trainer:
         opt_state = multihost_utils.broadcast_one_to_all(opt_state)
         return meta, params, opt_state
 
-    def restore(self, path: Optional[str] = None) -> dict:
-        """Load a checkpoint (default: latest) into the live trainer state.
-
-        Multi-host jobs read on the lead and broadcast (see the module
-        docstring), so ``out_dir`` may be host-local.
-        """
-        path = path or self.latest_path
-        meta, params, opt_state = self._load_state(path)
-        self.params = self.placement.put(params, "state")
-        self.opt_state = self.placement.put(opt_state, "state")
+    def _apply_meta(self, meta: dict) -> None:
+        """Install a checkpoint's meta into the live loop state, including
+        the mid-epoch resume cursor when the save was mid-epoch."""
         self.epoch = meta["epoch"]
         self.best_val = meta["best_val"]
         self.patience_left = meta["patience_left"]
         self._kept = [tuple(entry) for entry in meta.get("kept", [])]
+        self.global_step = int(meta.get("global_step", 0))
+        self._last_cadence_step = self.global_step
+        self._resume_skip = int(meta.get("batch_in_epoch", 0))
+        scale = float(meta.get("lr_scale", 1.0))
+        if scale != self._lr_scale:
+            self._set_lr_scale(scale)
+        if self._resume_skip:
+            # exact resume replays the interrupted epoch's remaining
+            # batches — only sound if the data order is reproduced, which
+            # (seed, shuffle, epoch) fully determines
+            if int(meta.get("seed", self.seed)) != self.seed:
+                raise ValueError(
+                    f"mid-epoch checkpoint was written with seed "
+                    f"{meta['seed']}, trainer has seed {self.seed} — the "
+                    "data order would differ; resume with the same seed"
+                )
+            if bool(meta.get("shuffle", self.shuffle)) != self.shuffle:
+                raise ValueError(
+                    f"mid-epoch checkpoint was written with "
+                    f"shuffle={meta['shuffle']}, trainer has "
+                    f"shuffle={self.shuffle} — the data order would differ"
+                )
+            if self._resume_skip > self._train_steps_per_epoch():
+                raise ValueError(
+                    f"mid-epoch resume cursor {self._resume_skip} exceeds "
+                    f"{self._train_steps_per_epoch()} steps per epoch — "
+                    "checkpoint from a different data configuration?"
+                )
+            partial = meta.get("partial") or {"losses": [], "counts": []}
+            # np.float32 roundtrips the f32 device scalar bit-exactly
+            # through JSON's float
+            self._epoch_losses = [np.float32(v) for v in partial["losses"]]
+            self._epoch_counts = [int(c) for c in partial["counts"]]
+            self._batch_in_epoch = self._resume_skip
+        else:
+            self._epoch_losses, self._epoch_counts = [], []
+            self._batch_in_epoch = 0
+
+    def restore(self, path: Optional[str] = None) -> dict:
+        """Load a checkpoint into the live trainer state.
+
+        With an explicit ``path``, that file is loaded (and must verify).
+        Without one, this is the strict resume: the newest *verified*
+        checkpoint in ``out_dir`` (via :meth:`restore_auto`), raising
+        ``FileNotFoundError`` when nothing resumable exists — use
+        :meth:`restore_auto` directly for resume-if-possible semantics.
+
+        Multi-host jobs read on the lead and broadcast (see the module
+        docstring), so ``out_dir`` may be host-local.
+        """
+        if path is None:
+            meta = self.restore_auto()
+            if meta is None:
+                raise FileNotFoundError(
+                    errno.ENOENT,
+                    "no verified checkpoint to resume from",
+                    self.latest_path,
+                )
+            return meta
+        meta, params, opt_state = self._load_state(path)
+        self.params = self.placement.put(params, "state")
+        self.opt_state = self.placement.put(opt_state, "state")
+        self._apply_meta(meta)
+        return meta
+
+    def restore_auto(self) -> Optional[dict]:
+        """Resume from the newest verified checkpoint, if any.
+
+        Walks ``load_latest_verified``'s recovery chain (latest -> rotated
+        previous latest -> best-k -> best), quarantining corrupt files, and
+        installs the first verified state. Returns its meta, or ``None``
+        when ``out_dir`` holds nothing loadable — the ``--resume auto``
+        fresh-start case. Multi-host jobs verify/read on the lead process
+        and broadcast the outcome so every process takes the same branch.
+        """
+        if jax.process_count() == 1:
+            self.flush_checkpoints()  # pending writes may own these paths
+            found = load_latest_verified(
+                self.out_dir, self.params, self.opt_state, log=self._log
+            )
+            if found is None:
+                return None
+            path, meta, params, opt_state = found
+            self.params = self.placement.put(params, "state")
+            self.opt_state = self.placement.put(opt_state, "state")
+            self._apply_meta(meta)
+            self._log(
+                f"resumed from {path} (epoch {self.epoch}, "
+                f"step {self.global_step})"
+            )
+            return meta
+        import json as _json
+
+        from jax.experimental import multihost_utils
+
+        # Same protocol as _load_state: lead-side outcomes (found / not
+        # found / failed) ride the meta broadcast so no process raises or
+        # returns before the collectives complete.
+        params, opt_state = self.params, self.opt_state
+        blob = np.zeros(0, np.uint8)
+        if self.is_lead:
+            try:
+                self.flush_checkpoints()
+                found = load_latest_verified(
+                    self.out_dir, self.params, self.opt_state, log=self._log
+                )
+                if found is None:
+                    meta = {"__none__": True}
+                else:
+                    _, meta, params, opt_state = found
+            except Exception as e:
+                meta = {"__load_error__": f"{type(e).__name__}: {e}"}
+            blob = np.frombuffer(_json.dumps(meta).encode(), dtype=np.uint8)
+        n = int(multihost_utils.broadcast_one_to_all(np.int64(blob.size)))
+        buf = np.zeros(n, np.uint8)
+        if self.is_lead:
+            buf[:] = blob
+        meta = _json.loads(bytes(np.asarray(
+            multihost_utils.broadcast_one_to_all(buf)
+        )).decode())
+        if "__load_error__" in meta:
+            raise RuntimeError(
+                f"lead process failed to resume from {self.out_dir}: "
+                f"{meta['__load_error__']}"
+            )
+        if meta.pop("__none__", False):
+            return None
+        params = multihost_utils.broadcast_one_to_all(params)
+        opt_state = multihost_utils.broadcast_one_to_all(opt_state)
+        self.params = self.placement.put(params, "state")
+        self.opt_state = self.placement.put(opt_state, "state")
+        self._apply_meta(meta)
         return meta
 
     def test(self, modes=("train", "test"), checkpoint: Optional[str] = "best") -> dict:

@@ -610,3 +610,76 @@ class TestCompatShim:
         np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
         # range()-compatible: the halo exchange builds ppermute tables
         assert all(isinstance(int(s), int) for s in sizes)
+
+
+class TestCollectiveChecks:
+    """collective-shape: static mesh-vs-operand math for every preset."""
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_collective_contracts
+
+        assert check_collective_contracts() == []
+
+    def test_scaled_preset_math_is_the_documented_margin(self):
+        """The scaled preset sits 6 rows inside the halo budget (bandwidth
+        150 vs budget 156 at shard size 313) — the check must know that."""
+        from stmgcn_tpu.analysis.collective_check import grid_bandwidth_estimate
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("scaled")
+        padded = -(-50 * 50 // cfg.mesh.region) * cfg.mesh.region
+        n_local = padded // cfg.mesh.region
+        assert (padded, n_local) == (2504, 313)
+        assert grid_bandwidth_estimate(cfg.model.kernel_type, cfg.model.K, 50) == 150
+        assert 150 <= n_local // 2 == 156
+
+    def test_ragged_dp_batch_fires(self):
+        from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+        from stmgcn_tpu.config import preset
+
+        bad = preset("multicity")
+        bad.train.batch_size = 30
+        f = check_collective_contracts([("bad", bad)])
+        assert [x.rule for x in f] == ["collective-shape"]
+        assert f[0].severity == "error" and "dp=8" in f[0].message
+
+    def test_branch_psum_raggedness_fires(self):
+        from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+        from stmgcn_tpu.config import preset
+
+        bad = preset("default")
+        bad.mesh.branch = 2  # m_graphs=3
+        f = check_collective_contracts([("bad", bad)])
+        assert any("m_graphs" in x.message for x in f)
+
+    def test_halo_exceeding_shard_fires(self):
+        from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+        from stmgcn_tpu.config import preset
+
+        bad = preset("scaled")
+        bad.mesh.halo = 999
+        f = check_collective_contracts([("bad", bad)])
+        assert any("ppermute" in x.message for x in f)
+
+    def test_banded_over_budget_and_oversharded_grid_fire(self):
+        from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+        from stmgcn_tpu.config import preset
+
+        bad = preset("scaled")
+        bad.mesh.region_strategy = "banded"
+        bad.mesh.halo = 100  # < bandwidth 150
+        f = check_collective_contracts([("bad", bad)])
+        assert any("halo budget 100" in x.message for x in f)
+
+        bad = preset("scaled")
+        bad.mesh.region = 64  # shard size 40 < bandwidth 150: no halo fits
+        f = check_collective_contracts([("bad", bad)])
+        assert any("exceeds the shard size 40" in x.message for x in f)
+
+    def test_single_device_configs_skipped(self):
+        from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("smoke")
+        cfg.train.batch_size = 31  # would be ragged on any dp mesh
+        assert check_collective_contracts([("smoke", cfg)]) == []

@@ -1,0 +1,408 @@
+"""Preemption-safety tests: fault injection, the verified checkpoint
+chain, exact mid-epoch resume, and the divergence guard.
+
+The acceptance drills mirror a preemptible-TPU job's life: SIGTERM lands
+mid-epoch (injected deterministically by a :class:`FaultPlan`), the
+emergency checkpoint is written, a fresh process ``--resume auto``-s and
+must end **bit-identical** to a run that was never interrupted; corrupt
+checkpoint bytes must never load silently (fallback + quarantine); a
+poisoned batch must trip the divergence guard, roll back, and leave the
+run bit-identical to one that never saw the batch.
+"""
+
+import json
+import os
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.resilience import (
+    DivergenceError,
+    DivergenceGuard,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    Preempted,
+)
+from stmgcn_tpu.train import (
+    CorruptCheckpointError,
+    Trainer,
+    load_checkpoint,
+    load_latest_verified,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from stmgcn_tpu.train import checkpoint as ckpt_mod
+
+
+def build(out_dir, fault_plan=None, shuffle=False, superstep=1, epochs=2, **kw):
+    data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 60, seed=1)
+    dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    sup = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8)
+    return Trainer(model, dataset, sup, n_epochs=epochs, batch_size=16,
+                   shuffle=shuffle, steps_per_superstep=superstep,
+                   data_placement="resident", out_dir=str(out_dir),
+                   fault_plan=fault_plan, verbose=False, **kw)
+
+
+def same(a, b):
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+def _toy_state():
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    opt_state = {"m": np.linspace(0.0, 1.0, 4, dtype=np.float32)}
+    return params, opt_state
+
+
+class TestCheckpointFormat:
+    def test_v2_roundtrip_and_verify(self, tmp_path):
+        params, opt_state = _toy_state()
+        path = str(tmp_path / "c.ckpt")
+        save_checkpoint(path, params, opt_state, {"epoch": 3})
+        meta = verify_checkpoint(path)
+        assert meta["epoch"] == 3
+        meta2, params_l, opt_l = load_checkpoint(path)
+        assert meta2["epoch"] == 3
+        same(params, params_l)
+        same(opt_state, opt_l)
+
+    def test_v1_files_still_load(self, tmp_path):
+        """Pre-chain checkpoints (no CRCs) written by older runs load."""
+        from flax import serialization
+
+        params, opt_state = _toy_state()
+        blobs = [
+            json.dumps({"epoch": 7}).encode("utf-8"),
+            serialization.to_bytes(params),
+            serialization.to_bytes(opt_state),
+        ]
+        data = ckpt_mod._MAGIC_V1 + b"".join(
+            struct.pack("<Q", len(b)) + b for b in blobs
+        )
+        path = tmp_path / "old.ckpt"
+        path.write_bytes(data)
+        meta, params_l, opt_l = load_checkpoint(str(path))
+        assert meta["epoch"] == 7
+        same(params, params_l)
+        same(opt_state, opt_l)
+
+    def test_truncation_detected_at_any_cut(self, tmp_path):
+        params, opt_state = _toy_state()
+        good = str(tmp_path / "good.ckpt")
+        save_checkpoint(good, params, opt_state, {"epoch": 1})
+        data = open(good, "rb").read()
+        cut_path = tmp_path / "cut.ckpt"
+        for cut in (3, 6, 10, 20, len(data) // 2, len(data) - 1):
+            cut_path.write_bytes(data[:cut])
+            with pytest.raises(ValueError):  # CorruptCheckpointError or magic
+                load_checkpoint(str(cut_path))
+            with pytest.raises(ValueError):
+                verify_checkpoint(str(cut_path))
+
+    def test_bitflip_detected_by_crc(self, tmp_path):
+        params, opt_state = _toy_state()
+        path = str(tmp_path / "c.ckpt")
+        save_checkpoint(path, params, opt_state, {"epoch": 1})
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0x01
+        open(path, "wb").write(bytes(data))
+        with pytest.raises(CorruptCheckpointError, match="CRC"):
+            verify_checkpoint(path)
+
+    def test_trailing_garbage_detected(self, tmp_path):
+        params, opt_state = _toy_state()
+        path = str(tmp_path / "c.ckpt")
+        save_checkpoint(path, params, opt_state, {"epoch": 1})
+        with open(path, "ab") as f:
+            f.write(b"extra")
+        with pytest.raises(CorruptCheckpointError, match="trailing"):
+            verify_checkpoint(path)
+
+
+class TestVerifiedChain:
+    def test_empty_dir_returns_none(self, tmp_path):
+        params, opt_state = _toy_state()
+        assert load_latest_verified(str(tmp_path), params, opt_state) is None
+
+    def test_fallback_and_quarantine(self, tmp_path):
+        params, opt_state = _toy_state()
+        save_checkpoint(str(tmp_path / "latest.prev.ckpt"), params, opt_state,
+                        {"epoch": 1})
+        latest = str(tmp_path / "latest.ckpt")
+        save_checkpoint(latest, params, opt_state, {"epoch": 2})
+        data = bytearray(open(latest, "rb").read())
+        data[len(data) // 2] ^= 0x01
+        open(latest, "wb").write(bytes(data))
+
+        path, meta, params_l, opt_l = load_latest_verified(
+            str(tmp_path), params, opt_state
+        )
+        assert os.path.basename(path) == "latest.prev.ckpt"
+        assert meta["epoch"] == 1
+        same(params, params_l)
+        assert not os.path.exists(latest)  # quarantined, never silently loaded
+        assert os.path.exists(latest + ".corrupt")
+
+    def test_best_snapshots_newest_epoch_first(self, tmp_path):
+        params, opt_state = _toy_state()
+        for name, epoch in (("best.ckpt", 2), ("best_e3.ckpt", 3),
+                            ("best_e5.ckpt", 5)):
+            save_checkpoint(str(tmp_path / name), params, opt_state,
+                            {"epoch": epoch})
+        path, meta, _, _ = load_latest_verified(str(tmp_path), params, opt_state)
+        assert os.path.basename(path) == "best_e5.ckpt"
+        assert meta["epoch"] == 5
+
+
+class TestFaultPlanUnit:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            FaultSpec("explode")
+        with pytest.raises(ValueError, match="step ordinal"):
+            FaultSpec("poison")
+        with pytest.raises(ValueError, match="keep_fraction"):
+            FaultSpec("truncate-write", keep_fraction=1.5)
+
+    def test_empty_plan_is_inert(self):
+        plan = FaultPlan()
+        assert not plan.active
+        plan.before_step(1, 0)
+        assert plan.mutate_write("latest.ckpt", b"abc") == b"abc"
+        assert plan.poison_value(1, 0) is None
+        assert not plan.should_drop(1, 0)
+
+    def test_raise_fires_once(self):
+        plan = FaultPlan(FaultSpec("raise", epoch=1, step=2))
+        plan.before_step(1, 0)  # no match
+        with pytest.raises(InjectedFault, match="epoch 1, step 2"):
+            plan.before_step(1, 2)
+        plan.before_step(1, 2)  # one-shot: re-running the ordinal is clean
+
+    def test_poison_and_drop_are_pure_matches(self):
+        plan = FaultPlan(
+            FaultSpec("poison", epoch=1, step=3), FaultSpec("drop", epoch=1, step=4)
+        )
+        for _ in range(2):  # rollback re-runs must re-fire
+            assert np.isnan(plan.poison_value(1, 3))
+            assert plan.should_drop(1, 4)
+        assert plan.poison_value(2, 3) is None
+        assert plan.any_drop(1, 3, 6)
+        assert not plan.any_drop(1, 5, 8)
+
+    def test_write_faults_count_matching_writes(self):
+        plan = FaultPlan(
+            FaultSpec("truncate-write", path_glob="latest.ckpt", write_index=1)
+        )
+        data = bytes(range(100))
+        assert plan.mutate_write("/out/latest.ckpt", data) == data  # index 0
+        assert plan.mutate_write("/out/best.ckpt", data) == data  # glob miss
+        assert plan.mutate_write("/out/latest.ckpt", data) == data[:50]
+        assert plan.mutate_write("/out/latest.ckpt", data) == data  # one-shot
+
+        plan = FaultPlan(FaultSpec("corrupt-write", flip_byte=7))
+        out = plan.mutate_write("x.ckpt", data)
+        assert len(out) == len(data) and out[7] == data[7] ^ 0x01
+
+
+class TestResumeParity:
+    """Interrupted-resume acceptance: SIGTERM mid-epoch, restart with
+    ``--resume auto``, final state bit-identical to the uninterrupted run
+    — across shuffle on/off and the per-step/superstep paths."""
+
+    @pytest.mark.parametrize("shuffle,superstep", [
+        (False, 1),
+        pytest.param(True, 1, marks=pytest.mark.slow),
+        pytest.param(False, 3, marks=pytest.mark.slow),
+        (True, 3),
+    ])
+    def test_sigterm_resume_bit_exact(self, tmp_path, shuffle, superstep):
+        ref = build(tmp_path / "ref", shuffle=shuffle, superstep=superstep)
+        ref_hist = ref.train()
+
+        plan = FaultPlan(FaultSpec("sigterm", epoch=2, step=4))
+        faulted = build(tmp_path / "run", fault_plan=plan, shuffle=shuffle,
+                        superstep=superstep)
+        with pytest.raises(Preempted, match="--resume auto"):
+            faulted.train()
+
+        resumed = build(tmp_path / "run", shuffle=shuffle, superstep=superstep)
+        meta = resumed.restore_auto()
+        assert meta is not None
+        assert meta["epoch"] == 2 and meta["batch_in_epoch"] > 0
+        hist = resumed.train()
+
+        same(ref.params, resumed.params)
+        same(ref.opt_state, resumed.opt_state)
+        # epoch 2's train loss is recomputed from the persisted partial
+        # per-batch losses — it must match the uninterrupted run's exactly
+        assert hist["train"][-1] == ref_hist["train"][-1]
+        assert hist["validate"][-1] == ref_hist["validate"][-1]
+
+    def test_restore_auto_fresh_start(self, tmp_path):
+        tr = build(tmp_path)
+        assert tr.restore_auto() is None  # --resume auto starts fresh
+
+    def test_bare_restore_raises_when_nothing_resumable(self, tmp_path):
+        tr = build(tmp_path)
+        with pytest.raises(FileNotFoundError, match="no verified checkpoint"):
+            tr.restore()
+
+    def test_raise_fault_with_step_cadence_resumes(self, tmp_path):
+        """A hard crash between epoch boundaries loses no steps when
+        ``checkpoint_every_steps`` keeps latest.ckpt fresh."""
+        ref = build(tmp_path / "ref")
+        ref.train()
+
+        plan = FaultPlan(FaultSpec("raise", epoch=2, step=3))
+        faulted = build(tmp_path / "run", fault_plan=plan,
+                        checkpoint_every_steps=1)
+        with pytest.raises(InjectedFault):
+            faulted.train()
+        faulted.flush_checkpoints()
+
+        # the emergency-free crash still left a verified mid-epoch cursor
+        meta = verify_checkpoint(str(tmp_path / "run" / "latest.ckpt"))
+        assert meta["epoch"] == 2  # the in-progress epoch being resumed
+        assert meta["batch_in_epoch"] == 3 and meta["global_step"] > 0
+        assert meta["shuffle"] is False
+        partial = meta["partial"]
+        assert len(partial["losses"]) == len(partial["counts"]) == 3
+
+        resumed = build(tmp_path / "run", checkpoint_every_steps=1)
+        assert resumed.restore_auto() is not None
+        resumed.train()
+        same(ref.params, resumed.params)
+        same(ref.opt_state, resumed.opt_state)
+
+
+class TestCorruptionDrill:
+    @pytest.mark.parametrize("kind", [
+        "corrupt-write",
+        pytest.param("truncate-write", marks=pytest.mark.slow),
+    ])
+    def test_corrupted_latest_falls_back_and_quarantines(self, tmp_path, kind):
+        """Bit rot / short write on the newest checkpoint: the restart must
+        fall back to the rotated previous latest, quarantining the bad file
+        — never silently loading it."""
+        plan = FaultPlan(FaultSpec(kind, path_glob="latest.ckpt", write_index=1))
+        tr = build(tmp_path, fault_plan=plan)
+        tr.train()  # epoch 2's latest write lands corrupted
+
+        restarted = build(tmp_path)
+        meta = restarted.restore_auto()
+        assert meta is not None and meta["epoch"] == 1  # latest.prev (epoch 1)
+        assert os.path.exists(tmp_path / "latest.ckpt.corrupt")
+        assert not os.path.exists(tmp_path / "latest.ckpt")
+
+
+class TestDivergenceGuard:
+    def test_guard_unit(self):
+        with pytest.raises(ValueError, match="action"):
+            DivergenceGuard(action="explode")
+        with pytest.raises(ValueError, match="patience"):
+            DivergenceGuard(patience=0)
+        with pytest.raises(ValueError, match="lr_cut"):
+            DivergenceGuard(lr_cut=1.5)
+        g = DivergenceGuard(patience=2)
+        g.trip(float("nan"), 1, 0)
+        g.ok()  # a finite step resets the consecutive counter
+        g.trip(float("inf"), 1, 2)
+        with pytest.raises(DivergenceError, match="--checkify nan"):
+            g.trip(float("nan"), 1, 3)
+        assert g.total == 3
+
+    @pytest.mark.parametrize("superstep", [1, 3])
+    def test_poisoned_batch_skip_matches_drop(self, tmp_path, superstep):
+        """Acceptance drill: a NaN-poisoned batch trips the guard, rolls
+        back, and the completed run is bit-identical to one that never saw
+        the batch (a drop fault at the same ordinal)."""
+        poisoned = build(
+            tmp_path / "poisoned",
+            fault_plan=FaultPlan(FaultSpec("poison", epoch=2, step=3)),
+            superstep=superstep, divergence_guard=True,
+        )
+        poisoned.train()
+        assert poisoned._guard.total == 1
+
+        control = build(
+            tmp_path / "control",
+            fault_plan=FaultPlan(FaultSpec("drop", epoch=2, step=3)),
+            superstep=superstep,
+        )
+        control.train()
+        same(control.params, poisoned.params)
+        same(control.opt_state, poisoned.opt_state)
+
+    def test_persistent_divergence_aborts_with_hint(self, tmp_path):
+        plan = FaultPlan(
+            FaultSpec("poison", epoch=1, step=1),
+            FaultSpec("poison", epoch=1, step=2),
+            FaultSpec("poison", epoch=1, step=3),
+        )
+        tr = build(tmp_path, fault_plan=plan, divergence_guard=True,
+                   divergence_patience=3)
+        with pytest.raises(DivergenceError, match="--checkify nan"):
+            tr.train()
+
+    def test_lr_cut_applied_and_persisted(self, tmp_path):
+        tr = build(
+            tmp_path,
+            fault_plan=FaultPlan(FaultSpec("poison", epoch=1, step=2)),
+            divergence_guard=True, divergence_lr_cut=0.5,
+        )
+        tr.train()
+        assert tr._lr_scale == 0.5
+        meta = verify_checkpoint(tr.latest_path)
+        assert meta["lr_scale"] == 0.5  # survives a resume
+
+
+class TestAsyncWriterFailure:
+    def test_failure_surfaces_then_writer_recovers(self, tmp_path):
+        tr = build(tmp_path / "out", epochs=1)
+        tr._write(str(tmp_path / "no_such_dir" / "x.ckpt"), b"data")
+        with pytest.raises(RuntimeError, match="background checkpoint") as exc:
+            tr.flush_checkpoints()
+        assert isinstance(exc.value.__cause__, FileNotFoundError)
+        # the worker survives the failed job: later saves land and verify
+        tr._save(tr.latest_path)
+        tr.flush_checkpoints()
+        assert verify_checkpoint(tr.latest_path)["epoch"] == 0
+
+
+class TestCLIFlags:
+    def test_resume_modes(self):
+        from stmgcn_tpu.cli import build_parser
+
+        p = build_parser()
+        assert p.parse_args([]).resume is None
+        assert p.parse_args(["--resume"]).resume == "strict"
+        assert p.parse_args(["--resume", "auto"]).resume == "auto"
+        with pytest.raises(SystemExit):
+            p.parse_args(["--resume", "bogus"])
+
+    def test_resilience_flags_reach_config(self):
+        from stmgcn_tpu.cli import build_parser, config_from_args
+
+        args = build_parser().parse_args([
+            "--divergence-guard", "--divergence-action", "defer",
+            "--divergence-patience", "5", "--divergence-lr-cut", "0.5",
+            "--checkpoint-every-steps", "25",
+        ])
+        cfg = config_from_args(args)
+        assert cfg.train.divergence_guard is True
+        assert cfg.train.divergence_action == "defer"
+        assert cfg.train.divergence_patience == 5
+        assert cfg.train.divergence_lr_cut == 0.5
+        assert cfg.train.checkpoint_every_steps == 25
+
+        cfg = config_from_args(build_parser().parse_args([]))
+        assert cfg.train.divergence_guard is False
+        assert cfg.train.checkpoint_every_steps == 0

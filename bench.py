@@ -734,7 +734,8 @@ def main() -> None:
     # whole measurement story with their platform provenance attached
     for key, fname, fields in (
         ("scaled_accuracy", "scaled_accuracy.json", ("test", "platform", "captured_at")),
-        ("serving", "serving_latency.json", ("legs", "platform", "captured_at")),
+        ("serving", "serving_latency.json",
+         ("legs", "speedup", "engine_stats", "platform", "captured_at")),
     ):
         path = os.path.join(BENCH_DIR, fname)
         if os.path.exists(path):

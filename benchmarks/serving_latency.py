@@ -1,23 +1,33 @@
 #!/usr/bin/env python
-"""Serving-path latency at the canonical shapes (one chip or CPU).
+"""Serving-path throughput/latency at the canonical shapes (one chip or CPU).
 
-Training throughput is bench.py's story; this measures the OTHER path a
-user of the reference cannot even take (the reference has no inference
-entry point at all — SURVEY.md C12 covers test-time scoring only):
+Training throughput is bench.py's story; this is the inference side —
+since the serving-engine PR it measures three generations of the path
+via :func:`stmgcn_tpu.serving.bench.run_serve_bench`:
 
-- ``forecaster``: :class:`stmgcn_tpu.inference.Forecaster` — checkpoint
-  -> rebuilt model -> jitted predict (normalize, forward, denormalize).
-- ``exported``: :class:`stmgcn_tpu.export.ExportedForecaster` — the AOT
-  serving artifact, loaded WITHOUT the model stack in a fresh process.
+- ``forecaster``/``exported``: the naive per-call predictors (the r05
+  legs whose batch-16 throughput sat *below* batch-1);
+- ``engine``: the shape-bucketed AOT programs, direct dispatch;
+- ``engine/microbatchN``: concurrent batch-1 clients coalesced by the
+  dynamic micro-batcher.
 
-Both measured at batch 1 (interactive latency) and the training batch
-(throughput serving), at the default preset's shapes (16x16 grid,
-T=5), after a warmup call (compile excluded — serving processes are
-long-lived). Trains a
-2-epoch throwaway checkpoint first; accuracy is irrelevant here, only
-the compiled prediction path's wall-clock. Writes
-``benchmarks/serving_latency.json`` with lock + host-load provenance
-(cpu-fallback records never overwrite an on-chip record).
+Every leg reports mean/p50/p95/p99 with warmup excluded; the record adds
+the engine's per-bucket telemetry (queue-wait vs device-time split, pad
+waste) and the two acceptance ratios (``speedup.b16_vs_b1``,
+``speedup.microbatch_vs_sequential_b1``). Trains a 2-epoch throwaway
+checkpoint first (accuracy irrelevant — only the compiled path's
+wall-clock). Writes ``benchmarks/serving_latency.json`` with lock +
+host-load provenance (cpu-fallback records never overwrite an on-chip
+record). Prints EXACTLY one JSON line on stdout.
+
+Operating point: 4x4 grid (N=16), slim hidden dims, ladder topped at
+the client count — the dispatch-dominated regime serving engines exist
+for (see ``stmgcn_tpu.serving.bench.train_throwaway``). At r05's 16x16
+the full model is memory-bound on this 1-core host and *no* software
+path can make batch-16 beat batch-1 per-row; shapes ride in the record
+either way. Env knobs (for the slow-tier contract test):
+STMGCN_SERVE_ROWS, STMGCN_SERVE_BATCH, STMGCN_SERVE_CLIENTS,
+STMGCN_SERVE_PER_CLIENT, STMGCN_SERVE_ITERS, STMGCN_SERVE_OUT.
 
 Usage: python benchmarks/serving_latency.py
 """
@@ -27,27 +37,20 @@ from __future__ import annotations
 import json
 import os
 import sys
-import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-OUT = os.path.join(REPO, "benchmarks", "serving_latency.json")
-
-
-def _timed(fn, warmup=2, iters=20) -> float:
-    for _ in range(warmup):
-        fn()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        fn()
-    return (time.perf_counter() - t0) / iters
+OUT = os.environ.get(
+    "STMGCN_SERVE_OUT", os.path.join(REPO, "benchmarks", "serving_latency.json")
+)
 
 
 def main() -> None:
     from stmgcn_tpu.utils.hostload import (
         host_load_snapshot,
         measurement_preamble,
+        persist_measurement,
         probe_backend_child,
     )
 
@@ -58,57 +61,41 @@ def main() -> None:
 
         force_host_platform("cpu")
 
-    import numpy as np
+    # the record line must stay alone on stdout — training/compile chatter
+    # from the throwaway run lands on stderr
+    record_stream = sys.stdout
+    sys.stdout = sys.stderr
+    try:
+        from stmgcn_tpu.serving.bench import run_serve_bench, train_throwaway
 
-    from stmgcn_tpu.config import preset
-    from stmgcn_tpu.experiment import build_trainer
-
-    cfg = preset("default")
-    cfg.data.rows = 16
-    cfg.data.n_timesteps = 24 * 7 * 2 + 64
-    cfg.train.epochs = 2
-    cfg.train.batch_size = 16
-    tmp = tempfile.mkdtemp(prefix="stmgcn_serving_")
-    cfg.train.out_dir = tmp
-    trainer = build_trainer(cfg, verbose=False)
-    trainer.train()
-
-    from stmgcn_tpu.export import ExportedForecaster, export_forecaster
-    from stmgcn_tpu.inference import Forecaster
-
-    fc = Forecaster.from_checkpoint(os.path.join(tmp, "best.ckpt"))
-    export_path = os.path.join(tmp, "model.stmgx")
-    export_forecaster(fc, export_path)
-    ex = ExportedForecaster.load(export_path)
-    ds = trainer.dataset
-    supports = np.asarray(cfg.model.support_config.build_all(ds.adjs.values()))
-    seq_len, n, c = cfg.data.seq_len, ds.n_nodes, ds.n_feats
-    rng = np.random.default_rng(0)
-
-    legs = {}
-    for batch in (1, cfg.train.batch_size):
-        history = (rng.random((batch, seq_len, n, c)) * 50).astype(np.float32)
-        for name, predictor in (("forecaster", fc), ("exported", ex)):
-            s = _timed(lambda p=predictor, h=history: p.predict(supports, h))
-            legs[f"{name}/b{batch}"] = {
-                "ms": round(s * 1e3, 3),
-                "predictions_per_sec": round(batch / s, 1),
-            }
-
-    record = {
-        "operating_point": f"serving-16x16-T{seq_len}",
-        "platform": "tpu" if on_tpu else "cpu-fallback",
-        "legs": legs,
-        "host_load": {
-            "before": load_before,
-            "after": host_load_snapshot(),
-            "lock": lock.record(),
-        },
-        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-    }
-    from stmgcn_tpu.utils.hostload import persist_measurement
-
-    persist_measurement(OUT, record, on_tpu, "serving_latency")
+        rows = int(os.environ.get("STMGCN_SERVE_ROWS", "4"))
+        batch = int(os.environ.get("STMGCN_SERVE_BATCH", "16"))
+        fc, supports = train_throwaway(rows=rows)
+        body = run_serve_bench(
+            fc,
+            supports,
+            batch=batch,
+            # top rung = the large-batch point = peak client concurrency,
+            # so saturated micro-batch dispatches run back-to-back
+            buckets=(1, 4, batch),
+            clients=int(os.environ.get("STMGCN_SERVE_CLIENTS", "16")),
+            per_client=int(os.environ.get("STMGCN_SERVE_PER_CLIENT", "40")),
+            iters=int(os.environ.get("STMGCN_SERVE_ITERS", "30")),
+        )
+        record = {
+            "operating_point": f"serving-{rows}x{rows}-T{fc.seq_len}",
+            "platform": "tpu" if on_tpu else "cpu-fallback",
+            **body,
+            "host_load": {
+                "before": load_before,
+                "after": host_load_snapshot(),
+                "lock": lock.record(),
+            },
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        persist_measurement(OUT, record, on_tpu, "serving_latency")
+    finally:
+        sys.stdout = record_stream
     print(json.dumps(record))
     lock.release()
 

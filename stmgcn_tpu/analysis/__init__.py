@@ -14,14 +14,17 @@ by tier-1 (``tests/test_analysis.py``):
   an unfenced span times *dispatch*, not compute), and train-step
   ``jax.jit`` calls missing ``donate_argnums``.
 - **Pass 2 — contract checks** (:mod:`.jaxpr_check`,
-  :mod:`.sharding_check`, :mod:`.collective_check`): abstractly trace
-  the smoke-preset step functions on CPU and assert jaxpr invariants (no
-  silent fp64 promotions, no weak-type outputs that would recompile step
-  2, a primitive-count budget guarding against fusion-breaking
-  regressions), static validation of every ``PartitionSpec`` literal
-  against the mesh axis names and the placement rank table, and
-  collective-shape math for every multi-device preset (ppermute halo
-  rows vs shard size, batch vs dp, m_graphs vs branch).
+  :mod:`.sharding_check`, :mod:`.collective_check`,
+  :mod:`.serving_check`): abstractly trace the smoke-preset step
+  functions (and one serving bucket program) on CPU and assert jaxpr
+  invariants (no silent fp64 promotions, no weak-type outputs that would
+  recompile step 2, a primitive-count budget guarding against
+  fusion-breaking regressions), static validation of every
+  ``PartitionSpec`` literal against the mesh axis names and the
+  placement rank table, collective-shape math for every multi-device
+  preset (ppermute halo rows vs shard size, batch vs dp, m_graphs vs
+  branch), and serving bucket-ladder math for every preset (strictly
+  increasing, covers max_batch, pad waste bounded).
 
 Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 ``# stmgcn: ignore``) on the offending line.
@@ -32,6 +35,7 @@ from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
 from stmgcn_tpu.analysis.report import Finding, render_json, render_text
 from stmgcn_tpu.analysis.rules import RULES, Rule
+from stmgcn_tpu.analysis.serving_check import check_serving_buckets
 from stmgcn_tpu.analysis.sharding_check import check_partition_specs
 
 __all__ = [
@@ -40,6 +44,7 @@ __all__ = [
     "Rule",
     "check_collective_contracts",
     "check_partition_specs",
+    "check_serving_buckets",
     "check_step_contracts",
     "lint_package",
     "lint_paths",

@@ -48,7 +48,7 @@ __all__ = [
 #: the count by seq_len), not single-op drift. Keep this a single-line
 #: literal: ``stmgcn lint --rebaseline`` rewrites it in place from the
 #: measured counts (:func:`rebaseline`).
-PRIMITIVE_BUDGETS = {"train_step": 860, "eval_step": 190, "train_superstep": 890, "train_step_checked": 3290}
+PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_step_checked": 3290}
 
 
 def _sub_jaxprs(params: dict):
@@ -145,6 +145,7 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
 
     from stmgcn_tpu.config import preset
     from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
+    from stmgcn_tpu.serving.engine import serve_bucket_fn
     from stmgcn_tpu.train import make_optimizer, make_step_fns, make_superstep_fns
     from stmgcn_tpu.train.step import make_checked_raw_train_step
 
@@ -170,8 +171,18 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     idx_block = jax.ShapeDtypeStruct((s_steps, b), jnp.int32)
     mask_block = jax.ShapeDtypeStruct((s_steps, b), f32)
 
+    # one serving bucket program (a mid-ladder rung): the engine compiles
+    # exactly this function per rung, so its fusion health is a serving
+    # contract just like the train step's
+    ladder = cfg.serving.buckets
+    bucket = ladder[len(ladder) // 2]
+    hist_bucket = jax.ShapeDtypeStruct((bucket, t, n, c), f32)
+
     params, opt_state = jax.eval_shape(fns.init, jax.random.PRNGKey(0), sup, x)
     return {
+        "serve_bucket": jax.make_jaxpr(serve_bucket_fn(model))(
+            params, sup, hist_bucket
+        ),
         "train_step": jax.make_jaxpr(fns.train_step)(
             params, opt_state, sup, x, y, mask
         ),

@@ -95,6 +95,14 @@ _ALL_RULES = [
         "branch) — the collective fails or drops data at runtime",
     ),
     Rule(
+        "serving-bucket-shape",
+        "error",
+        "a preset's serving bucket ladder is unservable (not strictly "
+        "increasing, tops out below max_batch, or a rung's worst-case pad "
+        "waste exceeds max_pad_waste) — engine construction would reject it "
+        "at deploy time",
+    ),
+    Rule(
         "partition-axis-name",
         "error",
         "PartitionSpec names a mesh axis that no mesh in this repo defines "

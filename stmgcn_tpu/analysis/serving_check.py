@@ -1,0 +1,53 @@
+"""Pass 2e: serving-bucket-shape contracts — static ladder math.
+
+The serving engine compiles one AOT program per ``ServingConfig.buckets``
+rung and pads every request batch up to its covering rung. A bad ladder
+fails only at engine construction — i.e. at deploy time, on the serving
+host. This pass re-derives the ladder contract from the config alone
+(the same :meth:`~stmgcn_tpu.config.ServingConfig.violations` math the
+engine enforces) and flags it at lint time instead: rungs must be
+strictly increasing, the top rung must cover ``max_batch`` (batches
+above it have no program), and no rung's worst-case padded waste — a
+batch one row past the previous rung — may exceed ``max_pad_waste``.
+Pure config math, safe without a JAX backend.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_serving_buckets"]
+
+
+def check_serving_buckets(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+) -> List[Finding]:
+    """Validate every preset's serving bucket ladder.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset.
+    """
+    from stmgcn_tpu.config import PRESETS
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+
+    findings: List[Finding] = []
+    for name, cfg in configs:
+        serving = getattr(cfg, "serving", None)
+        if serving is None:
+            continue
+        for message in serving.violations():
+            findings.append(
+                Finding(
+                    rule="serving-bucket-shape",
+                    path=f"<contract:serving:{name}>",
+                    line=0,
+                    message=f"{name}: {message}",
+                    severity=RULES["serving-bucket-shape"].severity,
+                )
+            )
+    return findings

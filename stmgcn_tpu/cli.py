@@ -13,6 +13,7 @@ Usage::
         -date 0101 0630 0701 0731 -cpt 3 1 1
     python -m stmgcn_tpu.cli --preset default --test-only --out-dir output
     python -m stmgcn_tpu.cli lint --format json   # static analysis gate
+    python -m stmgcn_tpu.cli serve-bench          # serving-engine benchmark
 """
 
 from __future__ import annotations
@@ -269,6 +270,12 @@ def main(argv=None) -> int:
         from stmgcn_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "serve-bench":
+        # serving-engine benchmark: naive vs AOT-bucketed vs micro-batched
+        # prediction throughput; one JSON record line on stdout
+        from stmgcn_tpu.serving.bench import main as serve_bench_main
+
+        return serve_bench_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.print_config:

@@ -25,6 +25,7 @@ __all__ = [
     "MeshConfig",
     "ModelConfig",
     "PRESETS",
+    "ServingConfig",
     "TrainConfig",
     "preset",
 ]
@@ -242,12 +243,85 @@ class MeshConfig:
 
 
 @dataclasses.dataclass
+class ServingConfig:
+    """Inference-engine shape policy (:mod:`stmgcn_tpu.serving.engine`).
+
+    The engine pre-compiles one AOT program per ``buckets`` rung and the
+    micro-batcher coalesces concurrent requests into the smallest
+    covering rung, waiting at most ``max_delay_ms`` for co-riders.
+    ``violations()`` is the ladder's static contract — pure config math,
+    shared by engine construction and the ``serving-bucket-shape``
+    analysis rule, so a bad ladder fails ``stmgcn lint`` before it fails
+    a deployment.
+    """
+
+    #: ascending batch-size ladder; one compiled program per rung. Keep 1
+    #: in the ladder so lone interactive requests never wait or pad.
+    buckets: tuple = (1, 4, 16, 64)
+    #: micro-batcher coalescing deadline (ms a request may wait for
+    #: co-riders when the pending rows don't exactly fill a rung)
+    max_delay_ms: float = 2.0
+    #: largest coalesced batch the ladder must cover (its top rung)
+    max_batch: int = 64
+    #: per-rung worst-case padded-waste bound: a batch one row past rung
+    #: ``p`` pads to the next rung ``b`` wasting ``(b - p - 1) / b`` —
+    #: ladders with bigger gaps than this fail validation
+    max_pad_waste: float = 0.75
+
+    def __post_init__(self):
+        # json round-trips hand lists back; the to_dict/from_dict identity
+        # (and hashing-adjacent uses) need the canonical tuple form
+        self.buckets = tuple(int(b) for b in self.buckets)
+
+    def violations(self) -> list:
+        """Every way this ladder is unservable (empty list = valid)."""
+        v = []
+        b = self.buckets
+        if not b:
+            return ["bucket ladder is empty"]
+        if any(x < 1 for x in b):
+            v.append(f"buckets must be >= 1, got {b}")
+        if any(y <= x for x, y in zip(b, b[1:])):
+            v.append(f"bucket ladder must be strictly increasing, got {b}")
+        if self.max_batch < 1:
+            v.append(f"max_batch must be >= 1, got {self.max_batch}")
+        elif b[-1] < self.max_batch:
+            v.append(
+                f"ladder tops out at {b[-1]} but max_batch is "
+                f"{self.max_batch} — batches above the top rung have no "
+                "program"
+            )
+        if not 0.0 <= self.max_pad_waste < 1.0:
+            v.append(
+                f"max_pad_waste must be in [0, 1), got {self.max_pad_waste}"
+            )
+        else:
+            prev = 0
+            for cur in b:
+                if cur <= prev:
+                    continue  # ordering already flagged above
+                waste = (cur - (prev + 1)) / cur
+                if waste > self.max_pad_waste:
+                    v.append(
+                        f"bucket {cur}: worst-case pad waste {waste:.3f} "
+                        f"(one row past rung {prev} pads {cur - prev - 1} of "
+                        f"{cur} rows) exceeds max_pad_waste "
+                        f"{self.max_pad_waste} — add an intermediate rung"
+                    )
+                prev = cur
+        if self.max_delay_ms < 0:
+            v.append(f"max_delay_ms must be >= 0, got {self.max_delay_ms}")
+        return v
+
+
+@dataclasses.dataclass
 class ExperimentConfig:
     name: str = "default"
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -260,6 +334,7 @@ class ExperimentConfig:
             model=ModelConfig(**d.get("model", {})),
             train=TrainConfig(**d.get("train", {})),
             mesh=MeshConfig(**d.get("mesh", {})),
+            serving=ServingConfig(**d.get("serving", {})),
         )
 
 

@@ -140,3 +140,14 @@ class Forecaster:
             history,
             normalized,
         )
+
+    def serving_engine(self, supports, *, config=None, city=None):
+        """A :class:`stmgcn_tpu.serving.ServingEngine` over this checkpoint:
+        per-bucket AOT programs (no per-call jit dispatch), params and
+        ``supports`` pinned device-resident, concurrent ``predict`` calls
+        micro-batched. Results are bit-identical to :meth:`predict`."""
+        from stmgcn_tpu.serving import ServingEngine
+
+        return ServingEngine.from_forecaster(
+            self, supports, config=config, city=city
+        )

@@ -1,7 +1,11 @@
 """Model layer: contextual-gated LSTM branches and the ST-MGCN flagship."""
 
 from stmgcn_tpu.models.cg_lstm import CGLSTM, ContextualGate
-from stmgcn_tpu.models.params import to_looped_params, to_vmapped_params
+from stmgcn_tpu.models.params import (
+    to_dense_serving,
+    to_looped_params,
+    to_vmapped_params,
+)
 from stmgcn_tpu.models.st_mgcn import STMGCN, Branch
 
 __all__ = [
@@ -9,6 +13,7 @@ __all__ = [
     "CGLSTM",
     "ContextualGate",
     "STMGCN",
+    "to_dense_serving",
     "to_looped_params",
     "to_vmapped_params",
 ]

@@ -17,10 +17,12 @@ checkpoint with the vmapped dense model). Non-branch subtrees (the
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["to_looped_params", "to_vmapped_params"]
+__all__ = ["to_dense_serving", "to_looped_params", "to_vmapped_params"]
 
 _VMAPPED_KEY = "branches"
 
@@ -43,6 +45,35 @@ def to_vmapped_params(variables, m_graphs: int):
         lambda *leaves: jnp.stack(leaves, axis=0), *per_branch
     )
     return {**variables, "params": params}
+
+
+def to_dense_serving(model, variables, m_graphs: int):
+    """Rebuild ``(model, params)`` as the dense vmapped XLA serving clone.
+
+    Serving (the export artifact and :class:`stmgcn_tpu.serving.engine
+    .ServingEngine`) always consumes dense ``(M, K, N, N)`` support
+    stacks on a single device: sparse/banded layouts, per-branch looping,
+    shard bindings and the Pallas LSTM kernel are training-side
+    representations. Sparse/looped checkpoints are restacked to the
+    vmapped layout (same modules, same math — round-trip + forward
+    equality pinned in tests/test_param_layouts.py); a Pallas-backend
+    model is re-routed to the xla scan of the same params
+    (tests/test_pallas_lstm.py). Already-dense models pass through
+    untouched.
+    """
+    if any(mode != "dense" for mode in model.branch_modes()) or not model.vmap_branches:
+        model = dataclasses.replace(
+            model,
+            sparse=False,
+            support_modes=None,
+            shard_spec=None,
+            vmap_branches=True,
+            n_real_nodes=None,
+        )
+        variables = to_vmapped_params(variables, m_graphs)
+    if model.lstm_backend != "xla":
+        model = dataclasses.replace(model, lstm_backend="xla", lstm_pallas_mesh=None)
+    return model, variables
 
 
 def to_looped_params(variables, m_graphs: int):

@@ -1,0 +1,36 @@
+"""High-throughput serving: shared predict flow + the bucketed engine.
+
+Layout:
+
+- :mod:`.predict` — ``serve_predict``, the numpy-only normalize → call →
+  denormalize flow shared by ``Forecaster``, ``ExportedForecaster`` and
+  the engine (one implementation, so raw-units contracts cannot drift);
+- :mod:`.bucketing` — shape-bucket arithmetic (covering rung, padding);
+- :mod:`.engine` — :class:`ServingEngine`: per-rung AOT programs with
+  device-resident supports/params, built from a live forecaster or an
+  export artifact;
+- :mod:`.microbatch` — the request queue coalescing concurrent callers
+  into one dispatch (exact-fit fast path, ``max_delay_ms`` deadline);
+- :mod:`.metrics` — per-bucket p50/p95/p99 latency, queue-wait vs
+  device-time split, pad-waste, throughput;
+- :mod:`.bench` — ``stmgcn serve-bench`` and the bench.py serving leg
+  (NOT imported here: it pulls the training stack for its throwaway
+  checkpoint, and this package must stay lean enough for
+  ``stmgcn_tpu.export`` — no flax, no models at import time).
+"""
+
+from stmgcn_tpu.serving.bucketing import pad_to_bucket, smallest_covering_bucket
+from stmgcn_tpu.serving.engine import ServingEngine, serve_bucket_fn
+from stmgcn_tpu.serving.metrics import EngineStats
+from stmgcn_tpu.serving.microbatch import MicroBatcher
+from stmgcn_tpu.serving.predict import serve_predict
+
+__all__ = [
+    "EngineStats",
+    "MicroBatcher",
+    "ServingEngine",
+    "pad_to_bucket",
+    "serve_bucket_fn",
+    "serve_predict",
+    "smallest_covering_bucket",
+]

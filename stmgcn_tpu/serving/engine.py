@@ -1,0 +1,301 @@
+"""Shape-bucketed AOT serving engine with dynamic micro-batching.
+
+The r05 serving numbers showed batching buying nothing: every
+``ExportedForecaster.predict`` call re-dispatched through jit (and
+re-uploaded the support stack), so batch 16 ran at batch-1 throughput.
+This engine removes both failure modes the way the superstep PR removed
+them for training:
+
+- **shape buckets, compiled ahead of time** — at construction the engine
+  lowers and compiles one program per ladder rung (``ServingConfig
+  .buckets``), so serving never traces, never recompiles, and never pays
+  jit dispatch: a request is one ``Compiled.__call__``.
+- **device-resident operands** — the support stack (and, for the live
+  path, the parameters) are placed on device once; the history window is
+  the only per-request upload.
+- **dynamic micro-batching** — concurrent callers coalesce into the
+  smallest covering rung (:mod:`stmgcn_tpu.serving.microbatch`), with
+  per-bucket latency/queue/pad-waste telemetry
+  (:mod:`stmgcn_tpu.serving.metrics`).
+
+Both predictor flavors feed the same engine: ``from_forecaster`` bakes a
+live checkpoint's dense serving clone, ``from_artifact`` specializes an
+exported StableHLO module's symbolic batch to each rung. Import-leanness
+contract: this module may import jax/numpy only at module scope — the
+model stack (flax, stmgcn_tpu.models) loads lazily inside
+``from_forecaster`` so ``import stmgcn_tpu.export`` stays lean
+(``tests/test_export.py::test_export_module_is_lean``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from stmgcn_tpu.serving.metrics import EngineStats
+from stmgcn_tpu.serving.microbatch import MicroBatcher
+
+__all__ = ["ServingEngine", "serve_bucket_fn"]
+
+
+def serve_bucket_fn(model):
+    """The per-bucket serving program (eval-mode forward, params explicit).
+
+    The one function the live-path engine compiles per ladder rung — and
+    the program the jaxpr contract pass traces as ``serve_bucket``, so a
+    fusion regression in the serving forward fails ``stmgcn lint`` the
+    same way a train-step regression does.
+    """
+
+    def serve_bucket(params, supports, history):
+        return model.apply(params, supports, history)
+
+    return serve_bucket
+
+
+class ServingEngine:
+    """Pre-compiled bucket ladder + micro-batcher over one model.
+
+    Build with :meth:`from_forecaster` (live checkpoint) or
+    :meth:`from_artifact` (exported StableHLO); then::
+
+        engine = ServingEngine.from_forecaster(fc, supports)
+        pred = engine.predict(history)          # micro-batched, raw units
+        pred = engine.predict_direct(history)   # bypass the queue
+        engine.stats.snapshot()                 # per-bucket telemetry
+        engine.close()
+
+    ``predict`` keeps the predictors' validate → normalize → call →
+    denormalize contract (normalization vectorized once per coalesced
+    dispatch), so results are bit-identical to ``Forecaster.predict`` at
+    any request size (padding parity pinned in tests/test_serving.py).
+    """
+
+    def __init__(self, programs, sup_dev, supports_np, normalizer, expected,
+                 config):
+        self._programs = dict(programs)  # bucket -> call(history_np) -> dev arr
+        self._sup_dev = sup_dev
+        self._supports_np = supports_np
+        self.normalizer = normalizer
+        self.expected = tuple(expected)  # (seq_len, n_nodes, input_dim)
+        self.config = config
+        self._buckets = tuple(sorted(self._programs))
+        self.stats = EngineStats()
+        self._batcher = MicroBatcher(
+            self._run_program, self._buckets, config.max_delay_ms, self.stats
+        )
+        self._closed = False
+
+    # -- construction ---------------------------------------------------
+
+    @staticmethod
+    def _resolve_config(config):
+        from stmgcn_tpu.config import ServingConfig
+
+        cfg = config if config is not None else ServingConfig()
+        bad = cfg.violations()
+        if bad:
+            raise ValueError("invalid serving config: " + "; ".join(bad))
+        return cfg
+
+    @staticmethod
+    def _check_supports(supports, want) -> np.ndarray:
+        supports_np = np.asarray(supports, dtype=np.float32)
+        if supports_np.shape != tuple(want):
+            raise ValueError(
+                f"supports must be {tuple(want)}, got {supports_np.shape}"
+            )
+        return supports_np
+
+    @classmethod
+    def from_forecaster(cls, fc, supports, *, config=None, city=None
+                        ) -> "ServingEngine":
+        """Engine over a live :class:`~stmgcn_tpu.inference.Forecaster`.
+
+        The checkpoint's model is rebuilt as its dense serving clone
+        (``models.to_dense_serving`` — sparse/looped layouts restacked,
+        pallas LSTM re-routed to xla) and each ladder rung compiled AOT
+        with params and supports pinned device-resident. Heterogeneous
+        multi-city checkpoints require ``city=`` exactly like
+        ``export_forecaster``.
+        """
+        from stmgcn_tpu.models import to_dense_serving
+
+        cfg = cls._resolve_config(
+            config if config is not None else getattr(fc.config, "serving", None)
+        )
+        hetero = getattr(fc, "normalizers", None) is not None
+        n_nodes, normalizer = fc.derived["n_nodes"], fc.normalizer
+        if hetero:
+            if city is None:
+                raise ValueError(
+                    "heterogeneous multi-city checkpoint: the engine bakes one "
+                    "city's region count and normalizer — pass city="
+                )
+            if not 0 <= city < len(fc.normalizers):
+                raise ValueError(
+                    f"city must be in [0, {len(fc.normalizers)}), got {city}"
+                )
+            n_nodes = n_nodes[city]
+            normalizer = fc.normalizers[city]
+        elif city is not None:
+            raise ValueError(
+                "city= only applies to heterogeneous multi-city checkpoints"
+            )
+
+        m = fc.config.model.m_graphs
+        model, params = to_dense_serving(fc.model, fc.params, m)
+        supports_np = cls._check_supports(
+            supports, (m, model.n_supports, n_nodes, n_nodes)
+        )
+        sup_dev = jax.device_put(jnp.asarray(supports_np))
+        params_dev = jax.tree.map(jnp.asarray, params)
+        expected = (fc.seq_len, n_nodes, fc.derived["input_dim"])
+        fn = serve_bucket_fn(model)
+
+        programs = {}
+        for b in cfg.buckets:
+            struct = jax.ShapeDtypeStruct((b,) + expected, jnp.float32)
+            compiled = jax.jit(fn).lower(params_dev, sup_dev, struct).compile()
+            # params/supports are the SAME resident arrays every call —
+            # the numpy history batch is the only per-request upload
+            # (Compiled takes it as-is; wrapping in jnp.asarray first
+            # just adds a dispatch-path round trip)
+            programs[b] = lambda h, c=compiled: c(params_dev, sup_dev, h)
+        return cls(programs, sup_dev, supports_np, normalizer, expected, cfg)
+
+    @classmethod
+    def from_artifact(cls, source, supports, *, config=None) -> "ServingEngine":
+        """Engine over an export artifact (path or loaded
+        :class:`~stmgcn_tpu.export.ExportedForecaster`).
+
+        The artifact's symbolic-batch StableHLO module is specialized and
+        compiled per ladder rung. The wrapped predictor is re-routed:
+        ``ex.predict(supports, history)`` now goes through the engine's
+        buckets (same supports required — the engine pinned them).
+        """
+        from stmgcn_tpu.export import ExportedForecaster
+
+        ex = ExportedForecaster.load(source) if isinstance(source, str) else source
+        cfg = cls._resolve_config(config)
+        meta = ex.meta
+        supports_np = cls._check_supports(
+            supports,
+            (meta["m_graphs"], meta["n_supports"], meta["n_nodes"],
+             meta["n_nodes"]),
+        )
+        sup_dev = jax.device_put(jnp.asarray(supports_np))
+        expected = (meta["seq_len"], meta["n_nodes"], meta["input_dim"])
+
+        programs = {}
+        for b in cfg.buckets:
+            struct = jax.ShapeDtypeStruct((b,) + expected, jnp.float32)
+            compiled = jax.jit(ex.exported.call).lower(sup_dev, struct).compile()
+            programs[b] = lambda h, c=compiled: c(sup_dev, h)
+        engine = cls(programs, sup_dev, supports_np, ex.normalizer, expected, cfg)
+        engine.exported = ex
+        ex._engine = engine  # route ex.predict through the bucket ladder
+        return engine
+
+    # -- serving --------------------------------------------------------
+
+    @property
+    def buckets(self) -> tuple:
+        return self._buckets
+
+    def _run_program(self, payload: np.ndarray, bucket: int,
+                     segments) -> np.ndarray:
+        """One dispatch: normalize (vectorized, once per *batch* — not
+        once per request), pad to the rung, run the compiled program,
+        denormalize. ``segments`` is ``((offset, n_rows, pre_normalized),
+        ...)`` in payload order; pre-normalized rows are kept verbatim.
+        Elementwise normalization + row-independent forward keep the
+        result bit-identical to the per-request flow."""
+        from stmgcn_tpu.serving.bucketing import pad_to_bucket
+
+        norm = self.normalizer
+        if norm is None or all(pre for _, _, pre in segments):
+            batch = payload
+        else:
+            batch = norm.transform(payload)
+            for ofs, n, pre in segments:
+                if pre:
+                    batch[ofs:ofs + n] = payload[ofs:ofs + n]
+        out = np.asarray(self._programs[bucket](pad_to_bucket(batch, bucket)))
+        return norm.inverse(out) if norm is not None else out
+
+    def _call_batched(self, history: np.ndarray, normalized: bool
+                      ) -> np.ndarray:
+        cap = self._buckets[-1]
+        if history.shape[0] <= cap:
+            return self._batcher.submit(history, tag=normalized)
+        # oversized batches split into ladder-top chunks (never a request)
+        parts = [
+            self._batcher.submit(history[i:i + cap], tag=normalized)
+            for i in range(0, history.shape[0], cap)
+        ]
+        return np.concatenate(parts, axis=0)
+
+    def _call_direct(self, history: np.ndarray, normalized: bool
+                     ) -> np.ndarray:
+        import time
+
+        from stmgcn_tpu.serving.bucketing import smallest_covering_bucket
+
+        cap = self._buckets[-1]
+        parts = []
+        for i in range(0, history.shape[0], cap):
+            chunk = history[i:i + cap]
+            bucket = smallest_covering_bucket(chunk.shape[0], self._buckets)
+            t0 = time.perf_counter()
+            out = self._run_program(
+                chunk, bucket, ((0, chunk.shape[0], normalized),)
+            )
+            device_ms = (time.perf_counter() - t0) * 1e3
+            self.stats.record_dispatch(
+                bucket, chunk.shape[0], [0.0], device_ms
+            )
+            parts.append(out[:chunk.shape[0]])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+    def _validate(self, history) -> np.ndarray:
+        history = np.asarray(history, dtype=np.float32)
+        if history.ndim != 4 or history.shape[1:] != self.expected:
+            raise ValueError(
+                f"history must be (B, seq_len={self.expected[0]}, "
+                f"n_nodes={self.expected[1]}, n_feats={self.expected[2]}) "
+                f"for this model, got {history.shape}"
+            )
+        return history
+
+    def predict(self, history, *, normalized: bool = False) -> np.ndarray:
+        """Micro-batched raw-units forecast — the concurrent-caller path.
+
+        Blocks until this request's coalesced dispatch completes; results
+        are bit-identical to ``Forecaster.predict`` on the same rows
+        (parity pinned in tests/test_serving.py). Normalization happens
+        inside the coalesced dispatch, vectorized over the whole bucket.
+        """
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        return self._call_batched(self._validate(history), normalized)
+
+    def predict_direct(self, history, *, normalized: bool = False) -> np.ndarray:
+        """Bypass the queue: pad to the covering rung and dispatch inline
+        (the latency-critical single-caller path; same results)."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        return self._call_direct(self._validate(history), normalized)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._batcher.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

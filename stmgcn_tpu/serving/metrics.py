@@ -1,0 +1,127 @@
+"""Per-bucket serving telemetry: latency percentiles, queue/device split.
+
+Every dispatch the engine makes — micro-batched or direct — lands here,
+so a long-lived engine can answer the capacity-planning questions the
+bucket ladder raises: which rungs actually fire, how much padding they
+waste, and where a request's wall time goes (queue wait vs device time).
+``snapshot()`` is what ``stmgcn serve-bench`` and the bench.py serving
+leg publish.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["EngineStats", "percentiles"]
+
+
+def percentiles(samples: List[float]) -> dict:
+    """p50/p95/p99/mean of a millisecond sample list (None when empty)."""
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None, "mean": None}
+    arr = np.asarray(samples, dtype=np.float64)
+    return {
+        "p50": round(float(np.percentile(arr, 50)), 3),
+        "p95": round(float(np.percentile(arr, 95)), 3),
+        "p99": round(float(np.percentile(arr, 99)), 3),
+        "mean": round(float(arr.mean()), 3),
+    }
+
+
+class _BucketStats:
+    __slots__ = ("dispatches", "requests", "rows", "queue_ms", "device_ms",
+                 "latency_ms")
+
+    def __init__(self):
+        self.dispatches = 0
+        self.requests = 0
+        self.rows = 0
+        self.queue_ms: List[float] = []   # one sample per request
+        self.device_ms: List[float] = []  # one sample per dispatch
+        self.latency_ms: List[float] = []  # queue + device, per request
+
+
+class EngineStats:
+    """Thread-safe accumulator; the micro-batch worker and any number of
+    direct-path callers record concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, _BucketStats] = {}
+        self._t_first = None  # wall window over all dispatches, for
+        self._t_last = None   # end-to-end throughput
+
+    def record_dispatch(self, bucket: int, rows: int, queue_ms: List[float],
+                        device_ms: float) -> None:
+        """One program dispatch: ``rows`` real rows in a ``bucket``-sized
+        batch, ``queue_ms`` holding each coalesced request's queue wait."""
+        now = time.perf_counter()
+        with self._lock:
+            bs = self._buckets.setdefault(bucket, _BucketStats())
+            bs.dispatches += 1
+            bs.requests += len(queue_ms)
+            bs.rows += rows
+            bs.device_ms.append(device_ms)
+            bs.queue_ms.extend(queue_ms)
+            bs.latency_ms.extend(q + device_ms for q in queue_ms)
+            start = now - device_ms / 1e3
+            if self._t_first is None or start < self._t_first:
+                self._t_first = start
+            if self._t_last is None or now > self._t_last:
+                self._t_last = now
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+            self._t_first = self._t_last = None
+
+    def snapshot(self) -> dict:
+        """A JSON-ready view: per-bucket percentiles + engine totals."""
+        with self._lock:
+            buckets = {
+                b: (bs.dispatches, bs.requests, bs.rows, list(bs.queue_ms),
+                    list(bs.device_ms), list(bs.latency_ms))
+                for b, bs in self._buckets.items()
+            }
+            window = (
+                self._t_last - self._t_first
+                if self._t_first is not None and self._t_last > self._t_first
+                else None
+            )
+        out: dict = {"buckets": {}, "totals": {}}
+        tot_rows = tot_reqs = tot_disp = tot_capacity = 0
+        all_queue: List[float] = []
+        all_device: List[float] = []
+        for b in sorted(buckets):
+            dispatches, requests, rows, queue_ms, device_ms, latency_ms = buckets[b]
+            capacity = dispatches * b
+            out["buckets"][str(b)] = {
+                "dispatches": dispatches,
+                "requests": requests,
+                "rows": rows,
+                "pad_waste": round(1.0 - rows / capacity, 4) if capacity else 0.0,
+                "latency_ms": percentiles(latency_ms),
+                "queue_wait_ms": percentiles(queue_ms),
+                "device_ms": percentiles(device_ms),
+            }
+            tot_rows += rows
+            tot_reqs += requests
+            tot_disp += dispatches
+            tot_capacity += capacity
+            all_queue.extend(queue_ms)
+            all_device.extend(device_ms)
+        out["totals"] = {
+            "dispatches": tot_disp,
+            "requests": tot_reqs,
+            "rows": tot_rows,
+            "pad_waste": round(1.0 - tot_rows / tot_capacity, 4)
+            if tot_capacity else 0.0,
+            "queue_wait_ms_mean": percentiles(all_queue)["mean"],
+            "device_ms_mean": percentiles(all_device)["mean"],
+            "rows_per_sec": round(tot_rows / window, 1) if window else None,
+        }
+        return out

@@ -4,7 +4,11 @@ Deliberately imports nothing but numpy: :class:`stmgcn_tpu.export
 .ExportedForecaster` promises to serve without the model stack (no flax,
 no config machinery), and :class:`stmgcn_tpu.inference.Forecaster` pulls
 the full framework — this module is the piece both can share so their
-raw-units contracts cannot drift.
+raw-units contracts cannot drift. :class:`stmgcn_tpu.serving.engine
+.ServingEngine` implements the same validate → normalize → call →
+denormalize contract with the normalization vectorized per coalesced
+dispatch; bit-identity between the two flows is pinned in
+tests/test_serving.py.
 """
 
 from __future__ import annotations

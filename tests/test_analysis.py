@@ -683,3 +683,55 @@ class TestCollectiveChecks:
         cfg = preset("smoke")
         cfg.train.batch_size = 31  # would be ragged on any dp mesh
         assert check_collective_contracts([("smoke", cfg)]) == []
+
+
+class TestServingBucketRule:
+    """Pass 2e: the serving-bucket-shape ladder contract (pure config
+    math — the same violations() the engine enforces at construction,
+    surfaced at lint time instead of deploy time)."""
+
+    def test_rule_registered_as_error(self):
+        assert RULES["serving-bucket-shape"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_serving_buckets
+
+        assert check_serving_buckets() == []
+
+    def test_flags_non_increasing_ladder(self):
+        from stmgcn_tpu.analysis import check_serving_buckets
+        from stmgcn_tpu.config import ServingConfig, preset
+
+        bad = preset("smoke")
+        bad.serving = ServingConfig(buckets=(4, 2, 1), max_batch=4)
+        f = check_serving_buckets([("bad", bad)])
+        assert f and all(x.rule == "serving-bucket-shape" for x in f)
+        assert all(x.severity == "error" for x in f)
+        assert any("strictly increasing" in x.message for x in f)
+        assert f[0].path == "<contract:serving:bad>"
+
+    def test_flags_ladder_below_max_batch(self):
+        from stmgcn_tpu.analysis import check_serving_buckets
+        from stmgcn_tpu.config import ServingConfig, preset
+
+        bad = preset("smoke")
+        bad.serving = ServingConfig(buckets=(1, 4, 16), max_batch=64)
+        f = check_serving_buckets([("bad", bad)])
+        assert any("max_batch" in x.message for x in f)
+
+    def test_flags_excessive_pad_waste(self):
+        from stmgcn_tpu.analysis import check_serving_buckets
+        from stmgcn_tpu.config import ServingConfig, preset
+
+        bad = preset("smoke")
+        # one row past rung 1 pads 14 of 16 rows: waste 0.875 > 0.5
+        bad.serving = ServingConfig(
+            buckets=(1, 16), max_batch=16, max_pad_waste=0.5
+        )
+        f = check_serving_buckets([("bad", bad)])
+        assert any("pad waste" in x.message for x in f)
+
+    def test_configs_without_serving_section_skipped(self):
+        from stmgcn_tpu.analysis import check_serving_buckets
+
+        assert check_serving_buckets([("none", object())]) == []

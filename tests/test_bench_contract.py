@@ -124,3 +124,58 @@ def test_bad_dtype_fails_loudly():
     rec = run_bench({**TINY, "STMGCN_BENCH_DTYPE": "float64"}, timeout=240)
     assert rec.get("error", "").startswith("bench exited"), rec
     assert "value" not in rec  # no throughput number from a refused config
+
+
+def test_serving_bench_record_contract(tmp_path):
+    """benchmarks/serving_latency.py: one JSON line on stdout, with the
+    serving-engine evidence the driver and README table consume — legs
+    with latency percentiles, the queue/device split, and both
+    acceptance ratios."""
+    import json
+    import subprocess
+
+    out_json = str(tmp_path / "serving.json")
+    env = {
+        **CLEAN_ENV,
+        "JAX_PLATFORMS": "cpu",
+        "STMGCN_SERVE_ROWS": "3",
+        "STMGCN_SERVE_BATCH": "4",
+        "STMGCN_SERVE_CLIENTS": "4",
+        "STMGCN_SERVE_PER_CLIENT": "10",
+        "STMGCN_SERVE_ITERS": "5",
+        "STMGCN_SERVE_OUT": out_json,
+        "STMGCN_BENCH_LOCK_PATH": "/tmp/stmgcn_serve_test.lock",
+    }
+    bench = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        "serving_latency.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, bench], env=env, capture_output=True, text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 1, f"stdout not a single record line: {proc.stdout!r}"
+    rec = json.loads(lines[0])
+    assert rec["platform"] in ("tpu", "cpu-fallback")
+    assert rec["captured_at"]
+    # every leg carries warmup-excluded latency percentiles + throughput
+    for leg in ("forecaster/b1", "forecaster/b4", "engine/b1", "engine/b4",
+                "engine/microbatch4"):
+        stats = rec["legs"][leg]
+        assert stats["p50_ms"] > 0 and stats["p99_ms"] >= stats["p50_ms"]
+        assert stats["predictions_per_sec"] > 0
+    # both acceptance ratios present (values are operating-point-dependent)
+    assert set(rec["speedup"]) == {"b16_vs_b1", "microbatch_vs_sequential_b1"}
+    # per-bucket telemetry splits queue wait from device time
+    totals = rec["engine_stats"]["totals"]
+    # stats reset after warmup: exactly the 4 clients x 10 measured requests
+    assert totals["requests"] == 40
+    assert totals["queue_wait_ms_mean"] is not None
+    assert totals["device_ms_mean"] is not None
+    for stats in rec["engine_stats"]["buckets"].values():
+        assert {"queue_wait_ms", "device_ms", "latency_ms",
+                "pad_waste"} <= set(stats)
+    assert rec["host_load"]["lock"]["acquired"] is True

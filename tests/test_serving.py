@@ -1,0 +1,277 @@
+"""ServingEngine contracts: padding parity, micro-batching, artifacts.
+
+Train-free like tests/test_export.py — a freshly-initialized flagship
+plus a fitted normalizer pins everything that matters: AOT bucket
+programs, BIT-exact padding parity against ``Forecaster.predict`` (the
+forward is row-independent and the normalizer elementwise, so padded
+rows must never perturb real rows — equality, not allclose), the
+micro-batcher's dispatch policy, and the per-shape program cache that
+fixes the ``ExportedForecaster.predict`` batch-scaling bug.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import ServingConfig, preset
+from stmgcn_tpu.data import (
+    DemandDataset,
+    MinMaxNormalizer,
+    WindowSpec,
+    synthetic_dataset,
+)
+from stmgcn_tpu.experiment import build_model
+from stmgcn_tpu.export import ExportedForecaster, export_forecaster
+from stmgcn_tpu.inference import Forecaster
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.serving import EngineStats, MicroBatcher, ServingEngine
+
+LADDER = ServingConfig(buckets=(1, 2, 4), max_batch=4, max_delay_ms=5.0)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = preset("smoke")
+    cfg.data.rows = 3
+    data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 40, seed=0)
+    ds = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    supports = np.asarray(
+        SupportConfig(cfg.model.kernel_type, cfg.model.K).build_all(ds.adjs.values()),
+        np.float32,
+    )[: cfg.model.m_graphs]
+    model = build_model(cfg, ds.n_feats)
+    x = jnp.zeros((2, cfg.data.seq_len, ds.n_nodes, ds.n_feats), jnp.float32)
+    params = model.init(jax.random.key(0), jnp.asarray(supports), x)
+    norm = MinMaxNormalizer.fit(np.asarray(data.demand))
+    fc = Forecaster(
+        model, params, norm, cfg, {"input_dim": ds.n_feats, "n_nodes": ds.n_nodes}
+    )
+    return fc, supports, ds
+
+
+@pytest.fixture(scope="module")
+def engine(setup):
+    fc, supports, _ = setup
+    eng = fc.serving_engine(supports, config=LADDER)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def artifact(setup, tmp_path_factory):
+    fc, supports, _ = setup
+    path = str(tmp_path_factory.mktemp("serving") / "model.stmgx")
+    export_forecaster(fc, path, platforms=("cpu",))
+    return path
+
+
+def _hist(fc, ds, b, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0, 50, (b, fc.seq_len, ds.n_nodes, ds.n_feats)).astype(
+        np.float32
+    )
+
+
+# -- padding parity (tentpole contract) --------------------------------
+
+
+@pytest.mark.parametrize("b", [1, 2, 3, 4])
+def test_padding_parity_bit_exact(setup, engine, b):
+    """Engine results across bucket boundaries (exact fits at 1/2/4,
+    padded at 3) are BIT-identical to the unpadded live predictor."""
+    fc, supports, ds = setup
+    hist = _hist(fc, ds, b)
+    ref = fc.predict(supports, hist)
+    np.testing.assert_array_equal(engine.predict_direct(hist), ref)
+    np.testing.assert_array_equal(engine.predict(hist), ref)
+
+
+def test_oversized_batch_splits_across_buckets(setup, engine):
+    """A request above the top rung is chunked, never rejected."""
+    fc, supports, ds = setup
+    hist = _hist(fc, ds, 7)  # cap is 4 -> chunks of 4 + 3
+    ref = fc.predict(supports, hist)
+    np.testing.assert_array_equal(engine.predict(hist), ref)
+    np.testing.assert_array_equal(engine.predict_direct(hist), ref)
+
+
+def test_prenormalized_input_parity(setup, engine):
+    fc, supports, ds = setup
+    hist = _hist(fc, ds, 3)
+    ref = fc.predict(supports, hist)
+    np.testing.assert_array_equal(
+        engine.predict(fc.normalizer.transform(hist), normalized=True), ref
+    )
+    np.testing.assert_array_equal(
+        engine.predict_direct(fc.normalizer.transform(hist), normalized=True), ref
+    )
+
+
+def test_engine_validates_history_and_close(setup):
+    fc, supports, ds = setup
+    eng = ServingEngine.from_forecaster(fc, supports, config=LADDER)
+    with pytest.raises(ValueError, match="history must be"):
+        eng.predict(np.ones((2, 99, ds.n_nodes, ds.n_feats), np.float32))
+    eng.close()
+    eng.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.predict(_hist(fc, ds, 1))
+
+
+def test_engine_rejects_bad_ladder(setup):
+    fc, supports, _ = setup
+    bad = ServingConfig(buckets=(4, 2, 1), max_batch=4)
+    with pytest.raises(ValueError, match="invalid serving config"):
+        ServingEngine.from_forecaster(fc, supports, config=bad)
+
+
+def test_engine_stats_split_queue_vs_device(setup, engine):
+    fc, _, ds = setup
+    engine.stats.reset()
+    engine.predict_direct(_hist(fc, ds, 3))
+    snap = engine.stats.snapshot()
+    assert snap["totals"]["requests"] == 1
+    (bucket,) = snap["buckets"]
+    assert bucket == "4"  # smallest covering rung for 3 rows
+    stats = snap["buckets"][bucket]
+    assert stats["pad_waste"] == pytest.approx(0.25)
+    assert stats["device_ms"]["p50"] > 0
+    assert stats["queue_wait_ms"]["p50"] == 0.0  # direct path never queues
+
+
+# -- exported-artifact path --------------------------------------------
+
+
+def test_engine_from_artifact_parity(setup, artifact):
+    fc, supports, ds = setup
+    with ServingEngine.from_artifact(artifact, supports, config=LADDER) as eng:
+        for b in (1, 3, 4):
+            hist = _hist(fc, ds, b)
+            np.testing.assert_allclose(
+                eng.predict(hist), fc.predict(supports, hist),
+                rtol=1e-5, atol=1e-4,
+            )
+
+
+def test_exported_predict_routes_through_engine(setup, artifact):
+    """Once wrapped, the artifact's own predict serves from the bucket
+    ladder (same results, telemetry visible in the engine stats)."""
+    fc, supports, ds = setup
+    ex = ExportedForecaster.load(artifact)
+    hist = _hist(fc, ds, 2)
+    before = ex.predict(supports, hist)
+    with ServingEngine.from_artifact(ex, supports, config=LADDER) as eng:
+        eng.stats.reset()
+        np.testing.assert_array_equal(ex.predict(supports, hist), before)
+        assert eng.stats.snapshot()["totals"]["requests"] == 1
+        with pytest.raises(ValueError, match="pinned"):
+            ex.predict(supports * 2.0, hist)
+
+
+def test_exported_per_shape_program_cache(setup, artifact):
+    """The batch-scaling bug fix: repeat shapes reuse one compiled
+    program instead of re-tracing through jit every call."""
+    fc, supports, ds = setup
+    ex = ExportedForecaster.load(artifact)
+    h2 = _hist(fc, ds, 2)
+    first = ex.predict(supports, h2)
+    np.testing.assert_array_equal(ex.predict(supports, h2), first)
+    assert len(ex._programs) == 1
+    ex.predict(supports, _hist(fc, ds, 5))
+    assert len(ex._programs) == 2
+
+
+# -- micro-batcher unit tests (no JAX involved) ------------------------
+
+
+def _rows(v, n=1):
+    return np.full((n, 3), v, np.float32)
+
+
+def test_microbatcher_coalesces_concurrent_requests():
+    dispatched = []
+
+    def dispatch(payload, bucket, segments):
+        dispatched.append((payload.shape[0], bucket, segments))
+        time.sleep(0.03)  # slow device: arrivals pile up behind it
+        return payload * 2.0
+
+    stats = EngineStats()
+    mb = MicroBatcher(dispatch, (1, 2, 4), max_delay_ms=50.0, stats=stats)
+    barrier = threading.Barrier(4)
+    results = {}
+
+    def client(i):
+        barrier.wait()
+        results[i] = mb.submit(_rows(float(i)))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mb.close()
+    for i in range(4):
+        np.testing.assert_array_equal(results[i], _rows(float(i)) * 2.0)
+    snap = stats.snapshot()
+    assert snap["totals"]["requests"] == 4
+    assert snap["totals"]["dispatches"] <= 3  # coalesced, not 4 singles
+
+
+def test_microbatcher_top_rung_dispatches_without_delay():
+    """A request that saturates the top rung must not wait out the
+    deadline — and an exact-fit payload is passed through zero-copy."""
+    seen = []
+    mb = MicroBatcher(
+        lambda p, b, s: (seen.append(p), p)[1],
+        (1, 2, 4),
+        max_delay_ms=5000.0,
+        stats=EngineStats(),
+    )
+    rows = _rows(7.0, n=4)
+    t0 = time.perf_counter()
+    out = mb.submit(rows)
+    elapsed = time.perf_counter() - t0
+    mb.close()
+    assert elapsed < 2.0  # nowhere near the 5 s deadline
+    assert seen[0] is rows  # exact fit: the caller's array itself
+    np.testing.assert_array_equal(out, rows)
+
+
+def test_microbatcher_deadline_fires_for_lone_request():
+    stats = EngineStats()
+    mb = MicroBatcher(
+        lambda p, b, s: p + 1.0, (1, 2, 4), max_delay_ms=40.0, stats=stats
+    )
+    t0 = time.perf_counter()
+    out = mb.submit(_rows(1.0, n=2))  # 2 rows < cap 4: waits for company
+    elapsed = time.perf_counter() - t0
+    mb.close()
+    np.testing.assert_array_equal(out, _rows(1.0, n=2) + 1.0)
+    assert 0.03 <= elapsed < 2.0  # released by the deadline, not saturation
+    assert stats.snapshot()["buckets"]["2"]["dispatches"] == 1
+
+
+def test_microbatcher_oversized_submit_rejected():
+    mb = MicroBatcher(lambda p, b, s: p, (1, 2), max_delay_ms=1.0,
+                      stats=EngineStats())
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        mb.submit(_rows(0.0, n=3))
+    mb.close()
+
+
+def test_microbatcher_dispatch_error_released_to_caller():
+    def dispatch(payload, bucket, segments):
+        raise RuntimeError("device fell over")
+
+    mb = MicroBatcher(dispatch, (1, 2), max_delay_ms=1.0, stats=EngineStats())
+    with pytest.raises(RuntimeError, match="device fell over"):
+        mb.submit(_rows(0.0))
+    # the worker survives a dying dispatch — next request still served
+    with pytest.raises(RuntimeError, match="device fell over"):
+        mb.submit(_rows(1.0))
+    mb.close()

@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -70,18 +71,25 @@ def main() -> None:
 
         rows = int(os.environ.get("STMGCN_SERVE_ROWS", "4"))
         batch = int(os.environ.get("STMGCN_SERVE_BATCH", "16"))
-        fc, supports = train_throwaway(rows=rows)
-        body = run_serve_bench(
-            fc,
-            supports,
-            batch=batch,
-            # top rung = the large-batch point = peak client concurrency,
-            # so saturated micro-batch dispatches run back-to-back
-            buckets=(1, 4, batch),
-            clients=int(os.environ.get("STMGCN_SERVE_CLIENTS", "16")),
-            per_client=int(os.environ.get("STMGCN_SERVE_PER_CLIENT", "40")),
-            iters=int(os.environ.get("STMGCN_SERVE_ITERS", "30")),
-        )
+        # one temp dir holds the throwaway checkpoint AND the export
+        # artifact through the measurement, then vanishes — both used to
+        # leak (mkdtemp'd dirs nothing ever removed)
+        with tempfile.TemporaryDirectory(prefix="stmgcn_serve_") as tmp:
+            fc, supports = train_throwaway(
+                rows=rows, out_dir=os.path.join(tmp, "ckpt")
+            )
+            body = run_serve_bench(
+                fc,
+                supports,
+                batch=batch,
+                # top rung = the large-batch point = peak client concurrency,
+                # so saturated micro-batch dispatches run back-to-back
+                buckets=(1, 4, batch),
+                clients=int(os.environ.get("STMGCN_SERVE_CLIENTS", "16")),
+                per_client=int(os.environ.get("STMGCN_SERVE_PER_CLIENT", "40")),
+                iters=int(os.environ.get("STMGCN_SERVE_ITERS", "30")),
+                artifact_path=os.path.join(tmp, "model.stmgx"),
+            )
         record = {
             "operating_point": f"serving-{rows}x{rows}-T{fc.seq_len}",
             "platform": "tpu" if on_tpu else "cpu-fallback",

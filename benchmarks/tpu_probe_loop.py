@@ -106,9 +106,20 @@ def maybe_mosaic_check() -> None:
         return
     py = sys.executable
     gate = [py, "benchmarks/mosaic_compile_check.py", "--probe"]
+    # The child's measurement_preamble waits up to 300s (default) for the
+    # bench lock BEFORE its 150s probe compile — under a default parent
+    # timeout the lock wait alone could eat the whole budget and a healthy
+    # compile path read as "down". Cap the child's lock wait short and
+    # size the parent timeout to the child's actual worst case:
+    # lock wait + probe compile + startup/teardown margin.
+    gate_lock_wait_s = 30
+    gate_compile_s = 150  # probe_compile_path(timeout_s=150) in the child
     try:
         out = subprocess.run(
-            gate, cwd=REPO, timeout=300, capture_output=True
+            gate, cwd=REPO,
+            timeout=gate_lock_wait_s + gate_compile_s + 60,
+            capture_output=True,
+            env={**os.environ, "STMGCN_BENCH_LOCK_WAIT": str(gate_lock_wait_s)},
         )
     except subprocess.TimeoutExpired:
         log("mosaic gate: compile path down (probe timed out)")

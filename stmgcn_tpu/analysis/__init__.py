@@ -23,8 +23,10 @@ by tier-1 (``tests/test_analysis.py``):
   ``PartitionSpec`` literal against the mesh axis names and the
   placement rank table, collective-shape math for every multi-device
   preset (ppermute halo rows vs shard size, batch vs dp, m_graphs vs
-  branch), and serving bucket-ladder math for every preset (strictly
-  increasing, covers max_batch, pad waste bounded).
+  branch), resident-memory math for every preset (window-free series vs
+  materialized-window footprint vs the per-core budget,
+  :mod:`.resident_check`), and serving bucket-ladder math for every
+  preset (strictly increasing, covers max_batch, pad waste bounded).
 
 Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 ``# stmgcn: ignore``) on the offending line.
@@ -34,6 +36,7 @@ from stmgcn_tpu.analysis.collective_check import check_collective_contracts
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
 from stmgcn_tpu.analysis.report import Finding, render_json, render_text
+from stmgcn_tpu.analysis.resident_check import check_resident_memory
 from stmgcn_tpu.analysis.rules import RULES, Rule
 from stmgcn_tpu.analysis.serving_check import check_serving_buckets
 from stmgcn_tpu.analysis.sharding_check import check_partition_specs
@@ -44,6 +47,7 @@ __all__ = [
     "Rule",
     "check_collective_contracts",
     "check_partition_specs",
+    "check_resident_memory",
     "check_serving_buckets",
     "check_step_contracts",
     "lint_package",

@@ -97,6 +97,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # lint must never queue on (or wake) an accelerator
         from stmgcn_tpu.analysis.collective_check import check_collective_contracts
         from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
+        from stmgcn_tpu.analysis.resident_check import check_resident_memory
         from stmgcn_tpu.analysis.serving_check import check_serving_buckets
         from stmgcn_tpu.analysis.sharding_check import check_partition_specs
         from stmgcn_tpu.utils.platform import force_host_platform
@@ -104,6 +105,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         force_host_platform("cpu")
         findings.extend(check_partition_specs())
         findings.extend(check_collective_contracts())
+        findings.extend(check_resident_memory())
         findings.extend(check_serving_buckets())
         findings.extend(check_step_contracts(args.preset))
     elif not args.paths:

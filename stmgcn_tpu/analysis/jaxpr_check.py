@@ -48,7 +48,7 @@ __all__ = [
 #: the count by seq_len), not single-op drift. Keep this a single-line
 #: literal: ``stmgcn lint --rebaseline`` rewrites it in place from the
 #: measured counts (:func:`rebaseline`).
-PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_step_checked": 3290}
+PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_step_checked": 3290}
 
 
 def _sub_jaxprs(params: dict):
@@ -146,7 +146,12 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     from stmgcn_tpu.config import preset
     from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
     from stmgcn_tpu.serving.engine import serve_bucket_fn
-    from stmgcn_tpu.train import make_optimizer, make_step_fns, make_superstep_fns
+    from stmgcn_tpu.train import (
+        make_optimizer,
+        make_series_superstep_fns,
+        make_step_fns,
+        make_superstep_fns,
+    )
     from stmgcn_tpu.train.step import make_checked_raw_train_step
 
     cfg = preset(preset_name)
@@ -156,6 +161,9 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     optimizer = make_optimizer(cfg.train.lr, cfg.train.weight_decay)
     fns = make_step_fns(model, optimizer, loss=cfg.train.loss)
     sfns = make_superstep_fns(model, optimizer, loss=cfg.train.loss)
+    wfns = make_series_superstep_fns(
+        model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon
+    )
 
     b = cfg.train.batch_size
     t = cfg.data.serial_len + cfg.data.daily_len + cfg.data.weekly_len
@@ -170,6 +178,11 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     y_all = jax.ShapeDtypeStruct((pool, n, c), f32)
     idx_block = jax.ShapeDtypeStruct((s_steps, b), jnp.int32)
     mask_block = jax.ShapeDtypeStruct((s_steps, b), f32)
+    # the window-free superstep's resident inputs: the raw series plus the
+    # int32 index vectors the on-device gather runs over
+    series = jax.ShapeDtypeStruct((cfg.data.n_timesteps, n, c), f32)
+    targets = jax.ShapeDtypeStruct((pool,), jnp.int32)
+    offsets = jax.ShapeDtypeStruct((t,), jnp.int32)
 
     # one serving bucket program (a mid-ladder rung): the engine compiles
     # exactly this function per rung, so its fusion health is a serving
@@ -189,6 +202,12 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
         "eval_step": jax.make_jaxpr(fns.eval_step)(params, sup, x, y, mask),
         "train_superstep": jax.make_jaxpr(sfns.train_superstep)(
             params, opt_state, sup, x_all, y_all, idx_block, mask_block
+        ),
+        # the window-free default: each scan step gathers its batch from
+        # the resident series on device (gather_window_batch) before the
+        # same shared raw train step
+        "train_series_superstep": jax.make_jaxpr(wfns.train_superstep)(
+            params, opt_state, sup, series, targets, offsets, idx_block, mask_block
         ),
         # the checkify-wrapped step --checkify nan actually runs (the
         # divergence-guard diagnostic path) — checked like the production

@@ -1,0 +1,149 @@
+"""Pass 2f: resident-memory contracts — static data-residency math.
+
+The trainer's resident data placement keeps training data in device HBM
+for the whole run; whether a preset *fits* is pure config arithmetic,
+the same way the collective-shape pass re-derives ppermute operands.
+Two representations exist (``train/trainer.py``):
+
+- **window-free** (default): the raw normalized ``(T, N, C)`` series per
+  city plus int32 target vectors — one copy of every timestep;
+- **materialized** windows: ``(S, seq_len, N, C)`` sample arrays — a
+  ~``seq_len``x copy, since consecutive windows overlap almost entirely.
+
+This pass estimates both footprints per preset from the config alone
+(synthetic demand is float32 with one channel; data arrays stay float32
+regardless of the model's compute dtype) and flags configurations whose
+*requested* residency cannot hold: ``data_placement="resident"`` with a
+multi-device mesh (the trainer raises at construction) or with a
+footprint beyond the per-core budget (the conservative
+``Trainer.RESIDENT_CAP_BYTES`` floor — devices that report more memory
+only relax this at runtime). ``"auto"`` placement never errors here: it
+degrades to streaming by design. No data build, no trace.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_resident_memory", "estimate_resident_bytes"]
+
+#: synthetic demand channels (stmgcn_tpu/data/synthetic.py emits one) and
+#: the pipeline's storage dtype (normalization casts to float32)
+_CHANNELS = 1
+_ITEMSIZE = 4
+
+
+def estimate_resident_bytes(cfg) -> dict:
+    """Both resident footprints for a config, in bytes.
+
+    Returns ``{"series_bytes", "materialized_bytes", "ratio"}`` summed
+    over cities: the window-free payload (series + int32 targets +
+    offset table) vs the materialized ``(x, y)`` window arrays — exactly
+    the arithmetic behind ``DemandDataset.resident_nbytes`` / ``nbytes``,
+    re-derived from config fields so no dataset is built.
+    """
+    from stmgcn_tpu.data.windowing import WindowSpec
+
+    d = cfg.data
+    spec = WindowSpec(
+        d.serial_len, d.daily_len, d.weekly_len, d.day_timesteps,
+        horizon=d.horizon,
+    )
+    n_cities = max(1, d.n_cities)
+    cols = d.cols if d.cols is not None else d.rows
+    if d.city_rows is not None:
+        nodes = [r * r for r in d.city_rows]
+    else:
+        nodes = [d.rows * cols] * n_cities
+    if d.city_timesteps is not None:
+        steps = list(d.city_timesteps)
+    else:
+        steps = [d.n_timesteps] * n_cities
+
+    series = materialized = targets = 0
+    for n, t in zip(nodes, steps):
+        s = max(0, spec.n_samples(t))
+        series += t * n * _CHANNELS * _ITEMSIZE
+        targets += 4 * s
+        materialized += (
+            s * (spec.seq_len + spec.horizon) * n * _CHANNELS * _ITEMSIZE
+        )
+    series_total = series + targets + 4 * spec.seq_len
+    return {
+        "series_bytes": series_total,
+        "materialized_bytes": materialized,
+        "ratio": materialized / series_total if series_total else 0.0,
+    }
+
+
+def check_resident_memory(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+    budget_bytes: Optional[int] = None,
+) -> List[Finding]:
+    """Validate requested data residency against the per-core budget.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset. Pure config math — safe without a JAX backend.
+    """
+    from stmgcn_tpu.config import PRESETS
+    from stmgcn_tpu.train.trainer import Trainer
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+    if budget_bytes is None:
+        budget_bytes = Trainer.RESIDENT_CAP_BYTES
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="resident-memory",
+                path=f"<contract:resident:{name}>",
+                line=0,
+                message=message,
+                severity=RULES["resident-memory"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        if cfg.train.data_placement != "resident":
+            # "auto" degrades to streaming when oversized; "stream" never
+            # holds data resident — nothing can fail at runtime
+            continue
+        if cfg.mesh.n_devices > 1:
+            emit(
+                name,
+                f"{name}: data_placement='resident' with a "
+                f"{cfg.mesh.n_devices}-device mesh — the trainer rejects "
+                "mesh-resident data (per-shard index translation is not "
+                "implemented); stream batches instead",
+            )
+            continue
+        est = estimate_resident_bytes(cfg)
+        window_free = (
+            cfg.train.window_free is not False and not cfg.data.hetero
+        )
+        resident = (
+            est["series_bytes"] if window_free else est["materialized_bytes"]
+        )
+        kind = "window-free series" if window_free else "materialized windows"
+        if resident > budget_bytes:
+            hint = (
+                " (the materialized fallback is forced: window_free=False/"
+                "hetero — the window-free series would be "
+                f"{est['series_bytes']:,} bytes)"
+                if not window_free and est["series_bytes"] <= budget_bytes
+                else ""
+            )
+            emit(
+                name,
+                f"{name}: resident data ({kind}) needs {resident:,} bytes "
+                f"but the per-core budget is {budget_bytes:,} — the run "
+                f"OOMs at the first epoch{hint}; use data_placement="
+                "'auto'/'stream' or shrink the series",
+            )
+    return findings

@@ -95,6 +95,14 @@ _ALL_RULES = [
         "branch) — the collective fails or drops data at runtime",
     ),
     Rule(
+        "resident-memory",
+        "error",
+        "a preset requests resident data placement its device cannot hold "
+        "(window-free series vs materialized windows vs the per-core "
+        "budget, or resident on a multi-device mesh) — the run OOMs or is "
+        "rejected at the first epoch",
+    ),
+    Rule(
         "serving-bucket-shape",
         "error",
         "a preset's serving bucket ladder is unservable (not strictly "

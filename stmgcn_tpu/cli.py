@@ -96,6 +96,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "dispatch with on-device batch gather (needs "
                         "resident data + shared graphs; bit-identical "
                         "results, S-fold fewer host dispatches; default 1)")
+    p.add_argument("--window-free", dest="window_free", action="store_true",
+                   default=None,
+                   help="require the window-free resident path: keep the raw "
+                        "(T, N, C) series on device and gather each batch's "
+                        "windows inside the jitted step (~seq_len x less "
+                        "resident HBM; default: on wherever it can hold)")
+    p.add_argument("--no-window-free", dest="window_free",
+                   action="store_false",
+                   help="force materialized window arrays (the bit-parity "
+                        "oracle / streaming-hetero fallback path)")
     p.add_argument("--normalize", choices=("minmax", "std", "none"), default=None,
                    help="demand normalization (reference parity: minmax to "
                         "[-1,1]; stats travel inside checkpoints either way)")
@@ -223,6 +233,7 @@ def config_from_args(args) -> "ExperimentConfig":
         ("patience", "patience"), ("top_k", "top_k"), ("seed", "seed"),
         ("checks", "checks"),
         ("out_dir", "out_dir"), ("data_placement", "data_placement"),
+        ("window_free", "window_free"),
         ("steps_per_superstep", "steps_per_superstep"),
         ("checkpoint_every_steps", "checkpoint_every_steps"),
         ("divergence_action", "divergence_action"),

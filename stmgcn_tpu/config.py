@@ -176,6 +176,14 @@ class TrainConfig:
     #: "auto" picks resident on a single device when the windowed arrays
     #: fit comfortably in HBM, else stream
     data_placement: str = "auto"
+    #: resident data representation: None (default) keeps the raw
+    #: normalized (T, N, C) series resident and reconstructs every batch
+    #: on device from target indices + the window offset table —
+    #: ~seq_len x fewer resident bytes, bit-identical results; False
+    #: forces the materialized-window resident arrays (the parity
+    #: oracle); True errors unless the window-free path is available
+    #: (homogeneous dataset, resident placement)
+    window_free: Optional[bool] = None
     #: fuse S train steps into one jitted lax.scan dispatch with on-device
     #: microbatch gather (train/step.py make_superstep_fns): one host
     #: dispatch + one loss readback per S optimizer steps. 1 (default) is

@@ -3,7 +3,12 @@
 Counterpart of the reference's ``DataGenerator.get_data_loader`` +
 ``TaxiDataset`` (``Data_Container.py:54-123``), redesigned for TPU:
 
-- windows are built once, vectorized, on the host (float32 numpy);
+- the dataset's primary storage is the normalized raw ``(T, N, C)``
+  series per city; materialized windows (``x`` of shape
+  ``(S, seq_len, N, C)`` — a ~``seq_len``x copy of the series) are built
+  lazily, vectorized, on first access, because the window-free resident
+  trainer path never needs them: it gathers windows on device from the
+  series via :meth:`DemandDataset.mode_targets` + ``WindowSpec.offsets``;
 - splits are computed per city and the per-mode slices of every city are
   concatenated, so multi-city training (BASELINE config 4) sees both
   cities in every mode rather than one city leaking entirely into test;
@@ -119,33 +124,123 @@ class DemandDataset:
         stacked = np.concatenate([d.demand for d in datas], axis=0)
         self.normalizer = norm_cls.fit(stacked) if norm_cls is not None else None
 
-        self._xs, self._ys = [], []
-        for d in datas:
-            demand = (
+        # Primary storage: one normalized (T, N, C) series per city. The
+        # materialized windows are derived lazily (see materialize()) —
+        # the window-free resident path never touches them.
+        self._series = [
+            (
                 self.normalizer.transform(d.demand)
                 if self.normalizer is not None
                 else d.demand
             ).astype(np.float32)
-            x, y = sliding_windows(demand, window)
-            self._xs.append(x)
-            self._ys.append(y)
+            for d in datas
+        ]
+        self._series_stack = None
+        self._xs = self._ys = None
 
-        per_city = self._ys[0].shape[0]
+        T = self._series[0].shape[0]
+        per_city = window.n_samples(T)
+        if per_city <= 0:
+            # the same error sliding_windows would raise — kept eager so a
+            # too-short series fails at construction, not at first access
+            raise ValueError(
+                f"need more than burn_in+horizon-1="
+                f"{window.burn_in + window.horizon - 1} timesteps, got T={T}"
+            )
         self.split = (
             split.validate_against(per_city)
             if split is not None
             else fraction_splits(per_city)
         )
 
+    def materialize(self) -> None:
+        """Build the windowed ``(x, y)`` sample arrays from the series.
+
+        The non-resident/hetero fallback (and the window-free path's
+        parity oracle): ``x[i] == series[targets[i] + offsets]`` by
+        construction, so the two representations are bit-identical views
+        of the same data. Idempotent; called lazily by every accessor
+        that needs host-side windows.
+        """
+        if self._xs is None:
+            pairs = [sliding_windows(s, self.window) for s in self._series]
+            self._xs = [x for x, _ in pairs]
+            self._ys = [y for _, y in pairs]
+
+    @property
+    def materialized(self) -> bool:
+        """Whether the windowed sample arrays have been built."""
+        return self._xs is not None
+
+    def series(self, city: int = 0) -> np.ndarray:
+        """One city's normalized ``(T, N, C)`` series — the window-free
+        resident payload; windows gather from it by target + offset."""
+        return self._series[city]
+
+    def series_stack(self) -> np.ndarray:
+        """All cities' series concatenated along time: ``(n_cities*T, N, C)``
+        (a zero-copy view for a single city).
+
+        :meth:`mode_targets` indices with ``city=None`` address this
+        tensor; window offsets never cross a city boundary because every
+        offset lies within ``burn_in`` of its target and every target sits
+        at least ``burn_in`` into its own city's block.
+        """
+        if self.n_cities == 1:
+            return self._series[0]
+        if self._series_stack is None:
+            self._series_stack = np.concatenate(self._series, axis=0)
+        return self._series_stack
+
+    def mode_targets(self, mode: str, city: int | None = None) -> np.ndarray:
+        """int32 target timesteps for a mode's samples, in ``arrays(mode)``
+        order.
+
+        ``city=None`` returns absolute indices into :meth:`series_stack`
+        (cities concatenated city-major, matching the ``arrays(mode)``
+        concatenation); ``city=k`` returns indices into ``series(k)``.
+        Sample ``i`` of the mode satisfies
+        ``arrays(mode)[0][i] == stack[targets[i] + window.offsets]`` and
+        ``arrays(mode)[1][i] == stack[targets[i] (+ arange(H))]`` exactly.
+        """
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        start, stop = self.split.range_for(mode)
+        base = self.window.burn_in + np.arange(start, stop)
+        if city is not None:
+            return base.astype(np.int32)
+        T = self._series[0].shape[0]
+        return np.concatenate(
+            [c * T + base for c in range(self.n_cities)]
+        ).astype(np.int32)
+
     @property
     def samples_per_city(self) -> int:
-        return self._ys[0].shape[0]
+        return self.window.n_samples(self._series[0].shape[0])
 
     @property
     def nbytes(self) -> int:
-        """Total bytes of the windowed sample arrays (all cities, all modes)
-        — what a device-resident consumer would upload."""
-        return sum(a.nbytes for a in self._xs) + sum(a.nbytes for a in self._ys)
+        """Bytes of the windowed sample arrays (all cities, all modes) —
+        what the materialized resident path would upload. Computed
+        analytically so sizing decisions never force materialization."""
+        per_sample = (
+            (self.window.seq_len + self.window.horizon)
+            * self.n_nodes
+            * self.n_feats
+        )
+        itemsize = self._series[0].dtype.itemsize
+        return self.n_cities * self.samples_per_city * per_sample * itemsize
+
+    @property
+    def resident_nbytes(self) -> int:
+        """Bytes the window-free resident path keeps on device: the raw
+        normalized series plus the int32 target vectors and offset table —
+        smaller than :attr:`nbytes` by ~``seq_len``x (windows overlap;
+        the series stores each timestep once)."""
+        series = sum(s.nbytes for s in self._series)
+        targets = 4 * self.n_samples  # one int32 target per sample
+        offsets = 4 * self.window.seq_len
+        return series + targets + offsets
 
     @property
     def n_samples(self) -> int:
@@ -153,11 +248,11 @@ class DemandDataset:
 
     @property
     def n_nodes(self) -> int:
-        return self._xs[0].shape[2]  # y may carry a horizon axis; x never does
+        return self._series[0].shape[1]
 
     @property
     def n_feats(self) -> int:
-        return self._xs[0].shape[3]
+        return self._series[0].shape[2]
 
     def mode_size(self, mode: str) -> int:
         """Total samples for a mode across all cities."""
@@ -166,8 +261,10 @@ class DemandDataset:
         return self.split.mode_len[mode] * self.n_cities
 
     def arrays(self, mode: str) -> tuple[np.ndarray, np.ndarray]:
-        """Full ``(x, y)`` for a mode — a view for one city, a cached concat otherwise."""
+        """Full ``(x, y)`` for a mode — a view for one city, a cached concat
+        otherwise. Materializes the windowed arrays on first use."""
         start, stop = self.split.range_for(mode)
+        self.materialize()
         if self.n_cities == 1:
             return self._xs[0][start:stop], self._ys[0][start:stop]
         if mode not in self._mode_cache:
@@ -180,6 +277,7 @@ class DemandDataset:
     def city_arrays(self, mode: str, city: int) -> tuple[np.ndarray, np.ndarray]:
         """One city's ``(x, y)`` views for a mode."""
         start, stop = self.split.range_for(mode)
+        self.materialize()
         return self._xs[city][start:stop], self._ys[city][start:stop]
 
     def denormalize(self, values):
@@ -218,7 +316,9 @@ class DemandDataset:
 
         ``with_arrays=False`` yields index-only batches (``x``/``y`` None):
         a device-resident consumer gathers on device from ``Batch.indices``,
-        so materializing host copies here would be pure waste.
+        so materializing host copies here would be pure waste — the
+        windowed arrays are not even built (the window-free path runs a
+        whole training job on indices + the raw series alone).
 
         With per-city graphs (``shared_graphs=False``) batches never mix
         cities — every batch carries the ``city`` whose support stack
@@ -226,24 +326,29 @@ class DemandDataset:
         """
         if drop_last and pad_last:
             raise ValueError("drop_last and pad_last are mutually exclusive")
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        per_mode = self.split.mode_len[mode]
         if self.shared_graphs:
             yield from self._iter_arrays(
-                self.arrays(mode), 0, batch_size, shuffle, (seed,), epoch,
-                drop_last, pad_last, with_arrays,
+                lambda: self.arrays(mode), per_mode * self.n_cities, 0,
+                batch_size, shuffle, (seed,), epoch, drop_last, pad_last,
+                with_arrays,
             )
             return
         for city in range(self.n_cities):
             yield from self._iter_arrays(
-                self.city_arrays(mode, city), city, batch_size, shuffle,
-                (seed, city), epoch, drop_last, pad_last, with_arrays,
+                lambda c=city: self.city_arrays(mode, c), per_mode, city,
+                batch_size, shuffle, (seed, city), epoch, drop_last,
+                pad_last, with_arrays,
             )
 
     def _iter_arrays(
-        self, arrays, city, batch_size, shuffle, seed_key, epoch, drop_last,
-        pad_last, with_arrays=True,
+        self, arrays_fn, n, city, batch_size, shuffle, seed_key, epoch,
+        drop_last, pad_last, with_arrays=True,
     ) -> Iterator[Batch]:
-        x, y = arrays
-        n = y.shape[0]
+        # arrays are a thunk so index-only iteration stays window-free
+        x = y = None
         order = None
         if shuffle:
             order = np.random.default_rng((*seed_key, epoch)).permutation(n)
@@ -260,6 +365,8 @@ class DemandDataset:
             if not with_arrays:
                 yield Batch(x=None, y=None, n_real=n_real, city=city, indices=sel)
                 continue
+            if x is None:
+                x, y = arrays_fn()
             if order is not None:
                 bx, by = x[sel[:n_real]], y[sel[:n_real]]
             else:  # contiguous: keep the zero-copy views

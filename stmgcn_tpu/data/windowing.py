@@ -100,6 +100,18 @@ class WindowSpec:
             parts.append(np.arange(-self.serial_len, 0))
         return np.concatenate(parts)
 
+    def target_indices(self, n_timesteps: int) -> np.ndarray:
+        """Target timesteps for every sample of a ``T``-step series.
+
+        Sample ``i`` targets timestep ``burn_in + i``; its observation
+        window is ``series[target + offsets]`` and its label is
+        ``series[target : target + horizon]``. This is the whole sample
+        enumeration — :func:`sliding_windows` is exactly the gather of
+        these targets, which is what lets the window-free resident path
+        ship targets + offsets instead of materialized windows.
+        """
+        return np.arange(self.burn_in, n_timesteps - self.horizon + 1)
+
 
 def sliding_windows(data, spec: WindowSpec) -> tuple[np.ndarray, np.ndarray]:
     """Extract all ``(x_seq, y)`` samples from a ``(T, N, C)`` demand tensor.
@@ -129,7 +141,7 @@ def sliding_windows(data, spec: WindowSpec) -> tuple[np.ndarray, np.ndarray]:
         got = native.window_gather(data, spec.offsets, spec.burn_in)
         if got is not None:
             return got
-    targets = np.arange(spec.burn_in, T - h + 1)
+    targets = spec.target_indices(T)
     x = data[targets[:, None] + spec.offsets[None, :]]
     if h == 1:
         y = data[targets]

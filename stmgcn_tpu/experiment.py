@@ -457,6 +457,7 @@ def build_trainer(
         top_k=t.top_k,
         prefetch=t.prefetch,
         data_placement=t.data_placement,
+        window_free=t.window_free,
         steps_per_superstep=t.steps_per_superstep,
         async_checkpoint=t.async_checkpoint,
         checkpoint_every_steps=t.checkpoint_every_steps,

@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
 import tempfile
 import threading
@@ -92,14 +93,24 @@ def train_throwaway(rows: int = 4, epochs: int = 2, batch_size: int = 16,
     cfg.data.n_timesteps = 24 * 7 * 2 + 64
     cfg.train.epochs = epochs
     cfg.train.batch_size = batch_size
-    cfg.train.out_dir = out_dir or tempfile.mkdtemp(prefix="stmgcn_serve_")
+    tmp_ckpt_dir = None
+    if out_dir is None:
+        # throwaway means throwaway: the checkpoint dir exists only long
+        # enough to round-trip the forecaster through from_checkpoint
+        tmp_ckpt_dir = tempfile.mkdtemp(prefix="stmgcn_serve_")
+        out_dir = tmp_ckpt_dir
+    cfg.train.out_dir = out_dir
     if slim:
         cfg.model.lstm_hidden_dim = 8
         cfg.model.lstm_num_layers = 1
         cfg.model.gcn_hidden_dim = 8
-    trainer = build_trainer(cfg, verbose=False)
-    trainer.train()
-    fc = Forecaster.from_checkpoint(os.path.join(cfg.train.out_dir, "best.ckpt"))
+    try:
+        trainer = build_trainer(cfg, verbose=False)
+        trainer.train()
+        fc = Forecaster.from_checkpoint(os.path.join(out_dir, "best.ckpt"))
+    finally:
+        if tmp_ckpt_dir is not None:
+            shutil.rmtree(tmp_ckpt_dir, ignore_errors=True)
     supports = np.asarray(
         cfg.model.support_config.build_all(trainer.dataset.adjs.values()),
         np.float32,
@@ -172,37 +183,45 @@ def run_serve_bench(fc, supports, *, batch: int = 16, buckets=(1, 4, 16),
         for b in (1, batch)
     }
 
+    # an internal artifact dir lives exactly as long as the measurement:
+    # the exported model must stay loadable through every timed leg, and
+    # the dir must not outlive this call (it used to leak one mkdtemp per
+    # bench run)
+    tmp_artifact_dir = None
     if artifact_path is None:
-        artifact_path = os.path.join(
-            tempfile.mkdtemp(prefix="stmgcn_serve_"), "model.stmgx"
-        )
-    export_forecaster(fc, artifact_path)
-    ex = ExportedForecaster.load(artifact_path)
+        tmp_artifact_dir = tempfile.mkdtemp(prefix="stmgcn_serve_")
+        artifact_path = os.path.join(tmp_artifact_dir, "model.stmgx")
+    try:
+        export_forecaster(fc, artifact_path)
+        ex = ExportedForecaster.load(artifact_path)
 
-    ladder = tuple(sorted(set(buckets)))
-    cfg = ServingConfig(
-        buckets=ladder, max_delay_ms=max_delay_ms, max_batch=ladder[-1],
-    )
-    engine = ServingEngine.from_forecaster(fc, supports, config=cfg)
+        ladder = tuple(sorted(set(buckets)))
+        cfg = ServingConfig(
+            buckets=ladder, max_delay_ms=max_delay_ms, max_batch=ladder[-1],
+        )
+        engine = ServingEngine.from_forecaster(fc, supports, config=cfg)
 
-    legs = {}
-    for b in (1, batch):
-        h = hist[b]
-        legs[f"forecaster/b{b}"] = _leg(
-            _timed(lambda h=h: fc.predict(supports, h), warmup, iters), b
+        legs = {}
+        for b in (1, batch):
+            h = hist[b]
+            legs[f"forecaster/b{b}"] = _leg(
+                _timed(lambda h=h: fc.predict(supports, h), warmup, iters), b
+            )
+            legs[f"exported/b{b}"] = _leg(
+                _timed(lambda h=h: ex.predict(supports, h), warmup, iters), b
+            )
+            legs[f"engine/b{b}"] = _leg(
+                _timed(lambda h=h: engine.predict_direct(h), warmup, iters), b
+            )
+        legs[f"engine/microbatch{batch}"] = _microbatch_leg(
+            engine, hist[1], clients, per_client
         )
-        legs[f"exported/b{b}"] = _leg(
-            _timed(lambda h=h: ex.predict(supports, h), warmup, iters), b
-        )
-        legs[f"engine/b{b}"] = _leg(
-            _timed(lambda h=h: engine.predict_direct(h), warmup, iters), b
-        )
-    legs[f"engine/microbatch{batch}"] = _microbatch_leg(
-        engine, hist[1], clients, per_client
-    )
 
-    stats = engine.stats.snapshot()
-    engine.close()
+        stats = engine.stats.snapshot()
+        engine.close()
+    finally:
+        if tmp_artifact_dir is not None:
+            shutil.rmtree(tmp_artifact_dir, ignore_errors=True)
     speedup = {
         # the r05 inversion check: engine batch-N rows/sec over batch-1
         "b16_vs_b1": round(
@@ -271,12 +290,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     record_stream = sys.stdout
     sys.stdout = sys.stderr  # anything a dependency prints stays off-record
     try:
-        fc, supports = train_throwaway(rows=args.rows, slim=not args.full_model)
-        record = run_serve_bench(
-            fc, supports, batch=args.batch, buckets=buckets,
-            max_delay_ms=args.max_delay_ms, clients=args.clients,
-            per_client=args.per_client, warmup=args.warmup, iters=args.iters,
-        )
+        # one temp dir holds the throwaway checkpoint AND the export
+        # artifact for exactly the measurement's lifetime (both leaked
+        # before: mkdtemp'd dirs nothing ever removed)
+        with tempfile.TemporaryDirectory(prefix="stmgcn_serve_") as tmp:
+            fc, supports = train_throwaway(
+                rows=args.rows, slim=not args.full_model,
+                out_dir=os.path.join(tmp, "ckpt"),
+            )
+            record = run_serve_bench(
+                fc, supports, batch=args.batch, buckets=buckets,
+                max_delay_ms=args.max_delay_ms, clients=args.clients,
+                per_client=args.per_client, warmup=args.warmup,
+                iters=args.iters,
+                artifact_path=os.path.join(tmp, "model.stmgx"),
+            )
         record["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )

@@ -17,9 +17,12 @@ from stmgcn_tpu.train.checkpoint import (
 )
 from stmgcn_tpu.train.metrics import MAE, MAPE, MSE, PCC, RMSE, regression_report
 from stmgcn_tpu.train.step import (
+    SeriesSuperstepFns,
     StepFns,
     SuperstepFns,
+    gather_window_batch,
     make_optimizer,
+    make_series_superstep_fns,
     make_step_fns,
     make_superstep_fns,
 )
@@ -33,12 +36,15 @@ __all__ = [
     "MSE",
     "PCC",
     "RMSE",
+    "SeriesSuperstepFns",
     "StepFns",
     "SuperstepFns",
     "Trainer",
+    "gather_window_batch",
     "load_checkpoint",
     "load_latest_verified",
     "make_optimizer",
+    "make_series_superstep_fns",
     "make_step_fns",
     "make_superstep_fns",
     "regression_report",

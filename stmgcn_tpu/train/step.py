@@ -27,10 +27,13 @@ import jax.numpy as jnp
 import optax
 
 __all__ = [
+    "SeriesSuperstepFns",
     "StepFns",
     "SuperstepFns",
+    "gather_window_batch",
     "make_checked_raw_train_step",
     "make_optimizer",
+    "make_series_superstep_fns",
     "make_step_fns",
     "make_superstep_fns",
 ]
@@ -143,6 +146,44 @@ class SuperstepFns:
     #: leading axis of the resident x_all/y_all, mask_block stacks the
     #: per-step loss masks ((S, B) or (S, B, N)), losses comes back (S,)
     train_superstep: Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class SeriesSuperstepFns:
+    """A jitted S-step superstep over the window-free resident series
+    (see :func:`make_series_superstep_fns`)."""
+
+    #: (params, opt_state, supports, series, targets, offsets, idx_block,
+    #: mask_block) -> (params, opt_state, losses); series is the resident
+    #: (T, N, C) normalized series, targets the mode's int32 target
+    #: timesteps, offsets the window's int32 gather offsets, idx_block
+    #: (S, B) int32 into targets — each scan step reconstructs its
+    #: microbatch with :func:`gather_window_batch` before the shared
+    #: train-step body
+    train_superstep: Callable
+
+
+def gather_window_batch(series, targets, offsets, idx, horizon: int = 1):
+    """Reconstruct a microbatch ``(x, y)`` from the resident raw series.
+
+    ``x[b] = series[targets[idx[b]] + offsets]`` and
+    ``y[b] = series[targets[idx[b]] (+ arange(horizon))]`` — the same
+    gather ``sliding_windows`` runs on the host, expressed as ``jnp.take``
+    so it executes on device from a resident ``(T, N, C)`` series. Pure
+    index copies, no arithmetic, so the result is bit-identical to the
+    materialized windows. This is the ONE definition site both the
+    per-step placement and the fused superstep body use; ``horizon`` is
+    static (it shapes ``y``).
+    """
+    tgt = jnp.take(targets, idx)
+    x = jnp.take(series, tgt[:, None] + offsets[None, :], axis=0)
+    if horizon == 1:
+        y = jnp.take(series, tgt, axis=0)
+    else:
+        y = jnp.take(
+            series, tgt[:, None] + jnp.arange(horizon)[None, :], axis=0
+        )
+    return x, y
 
 
 #: checkify error-set names accepted by ``make_step_fns(checks=...)``
@@ -362,3 +403,70 @@ def make_superstep_fns(
         return out
 
     return SuperstepFns(train_superstep=checked_superstep)
+
+
+def make_series_superstep_fns(
+    model,
+    optimizer: optax.GradientTransformation,
+    loss: str = "mse",
+    horizon: int = 1,
+    checks: str | None = None,
+) -> SeriesSuperstepFns:
+    """The superstep of :func:`make_superstep_fns` over window-free data.
+
+    Instead of gathering microbatches from materialized ``(S_mode, seq,
+    N, C)`` window arrays, each scan step reconstructs its batch from the
+    resident raw ``(T, N, C)`` series via :func:`gather_window_batch`
+    (index block -> target timesteps -> target + offset-table gather) —
+    the resident footprint drops from ~``seq_len`` copies of every
+    timestep to one. The gather is a pure copy, the scan body is the same
+    shared raw train step, and the losses come back as ordered scan ys,
+    so results stay bit-identical to the materialized superstep and to
+    the per-step loop. ``horizon`` is static (it shapes ``y``); ``checks``
+    wraps the whole program in checkify as in :func:`make_superstep_fns`.
+    """
+    if checks is not None and checks not in CHECK_SETS:
+        raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
+
+    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+
+    def train_superstep(
+        params, opt_state, supports, series, targets, offsets, idx_block, mask_block
+    ):
+        def body(carry, step_inputs):
+            params, opt_state = carry
+            idx, mask = step_inputs
+            x, y = gather_window_batch(series, targets, offsets, idx, horizon)
+            params, opt_state, loss_val = train_step(
+                params, opt_state, supports, x, y, mask
+            )
+            return (params, opt_state), loss_val
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (idx_block, mask_block)
+        )
+        return params, opt_state, losses
+
+    if checks is None:
+        return SeriesSuperstepFns(
+            train_superstep=jax.jit(train_superstep, donate_argnums=(0, 1))
+        )
+
+    from jax.experimental import checkify
+
+    ck = jax.jit(
+        checkify.checkify(train_superstep, errors=_error_set(checks)),
+        donate_argnums=(0, 1),
+    )
+
+    def checked_superstep(
+        params, opt_state, supports, series, targets, offsets, idx_block, mask_block
+    ):
+        err, out = ck(
+            params, opt_state, supports, series, targets, offsets, idx_block,
+            mask_block,
+        )
+        checkify.check_error(err)
+        return out
+
+    return SeriesSuperstepFns(train_superstep=checked_superstep)

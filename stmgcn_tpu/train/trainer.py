@@ -15,12 +15,20 @@ with the same control semantics, restructured for JAX:
   quirk 5);
 - per-epoch JSONL records land in ``<out_dir>/history.jsonl`` in addition
   to stdout prints (SURVEY.md §5.e);
-- batch data placement: ``data_placement="resident"`` uploads each split
-  to the device once and gathers batches by index on device (per-batch
+- batch data placement: ``data_placement="resident"`` keeps the data on
+  device once and gathers batches by index on device (per-batch
   host->device copies leave the epoch entirely; single-device only),
   ``"stream"`` uploads per batch with ``prefetch`` overlap, ``"auto"``
-  (default) picks resident on a single device when the windowed arrays
-  fit comfortably in HBM.
+  (default) picks resident on a single device when the resident payload
+  fits comfortably in HBM. The resident payload is **window-free** by
+  default (``window_free``): ONE normalized ``(T, N, C)`` series per
+  city plus per-mode int32 target vectors stay resident, and every
+  train/eval batch is reconstructed on device as
+  ``series[target + offsets]`` (``train/step.py gather_window_batch``)
+  — ~``seq_len``x fewer resident bytes than the materialized windows,
+  bit-identical results (the gather is a pure copy). ``window_free=
+  False`` keeps the materialized-window resident path (the parity
+  oracle); heterogeneous datasets always use it.
 
 Preemption safety (stmgcn_tpu/resilience): a ``FaultPlan`` threads
 deterministic fault injection through this loop behind a no-op default;
@@ -65,7 +73,13 @@ from stmgcn_tpu.train.checkpoint import (
     write_checkpoint_bytes,
 )
 from stmgcn_tpu.train.metrics import regression_report
-from stmgcn_tpu.train.step import make_optimizer, make_step_fns, make_superstep_fns
+from stmgcn_tpu.train.step import (
+    gather_window_batch,
+    make_optimizer,
+    make_series_superstep_fns,
+    make_step_fns,
+    make_superstep_fns,
+)
 
 __all__ = ["Trainer"]
 
@@ -166,6 +180,7 @@ class Trainer:
         prefetch: int = 1,
         node_pad=0,
         data_placement: str = "auto",
+        window_free: Optional[bool] = None,
         steps_per_superstep: int = 1,
         async_checkpoint: bool = True,
         checkpoint_every_steps: int = 0,
@@ -266,6 +281,13 @@ class Trainer:
         self._last_cadence_step = 0
         self._lr_scale = 1.0  # cumulative divergence-guard LR cut
         self._resident_cache: dict = {}
+        # window-free residency: the per-city device series, the per-(mode,
+        # city) device target vectors, and the window's offset table
+        self._resident_series_cache: dict = {}
+        self._resident_targets_cache: dict = {}
+        self._offsets_dev = None
+        window = getattr(dataset, "window", None)
+        self._horizon = window.horizon if window is not None else 1
         #: serialize on the training thread (device->host snapshot), write
         #: the file from a background worker — IO leaves the epoch's
         #: critical path. Reads (restore/test) flush pending writes first.
@@ -310,11 +332,37 @@ class Trainer:
                 "data_placement='resident' requires a single-device "
                 "placement; mesh runs stream batches (with prefetch)"
             )
+        # Window-free residency needs the series/targets protocol — the
+        # homogeneous DemandDataset has it, the heterogeneous dataset
+        # (per-city shapes) falls back to materialized windows.
+        wf_supported = hasattr(dataset, "series") and hasattr(
+            dataset, "mode_targets"
+        )
+        if window_free and not wf_supported:
+            raise ValueError(
+                "window_free=True requires a homogeneous DemandDataset "
+                "(the heterogeneous dataset has no shared series protocol)"
+            )
+        wf_candidate = wf_supported and window_free is not False
+        # "auto" sizes against what would actually sit in HBM: the raw
+        # series (+ targets) on the window-free path — ~seq_len x smaller
+        # — so long-window configs stop being capacity-bound here
+        resident_bytes = (
+            dataset.resident_nbytes if wf_candidate else dataset.nbytes
+        )
         self._resident = self.data_placement == "resident" or (
             self.data_placement == "auto"
             and not meshy
-            and dataset.nbytes <= self._resident_cap_bytes()
+            and resident_bytes <= self._resident_cap_bytes()
         )
+        #: resident batches gather from the raw series on device instead of
+        #: materialized window arrays (bit-identical; see module docstring)
+        self._window_free = wf_candidate and self._resident
+        if window_free and not self._window_free:
+            raise ValueError(
+                "window_free=True requires resident data placement "
+                "(stream/mesh placements upload per batch)"
+            )
 
         for mode in ("train", "validate"):
             if dataset.mode_size(mode) == 0:
@@ -352,9 +400,16 @@ class Trainer:
 
         self._make_fns = _fresh_fns
         self.step_fns = _fresh_fns(model)
-        # built lazily on first superstep epoch — most trainers never need it
-        self._make_superstep_fns = lambda: make_superstep_fns(
-            model, self._optimizer, loss, checks=checks
+        # built lazily on first superstep epoch — most trainers never need
+        # it; the window-free variant gathers each scan step's microbatch
+        # from the resident series instead of materialized window arrays
+        self._make_superstep_fns = lambda: (
+            make_series_superstep_fns(
+                model, self._optimizer, loss,
+                horizon=self._horizon, checks=checks,
+            )
+            if self._window_free
+            else make_superstep_fns(model, self._optimizer, loss, checks=checks)
         )
         self._superstep_fns = None
         # Per-city gate pooling under per-city node padding: cities with
@@ -374,7 +429,12 @@ class Trainer:
             else None
         )
         self._city_fns: dict = {}
-        example = next(dataset.batches("train", batch_size, pad_last=True))
+        # window-free: an index-only example batch keeps even init off the
+        # materialized windows — no host window array is ever built
+        example = next(dataset.batches(
+            "train", batch_size, pad_last=True,
+            with_arrays=not self._window_free,
+        ))
         example_x, _, _ = self._place_batch(example, "train")  # node-padded when needed
         self.params, self.opt_state = self.step_fns.init(
             jax.random.key(seed), self._supports_for(example), example_x
@@ -633,9 +693,21 @@ class Trainer:
         sample_mask = (np.arange(len(batch)) < batch.n_real).astype(np.float32)
         pad = self._pad_for(batch.city)
         if self._resident and batch.indices is not None:
+            idx = jnp.asarray(batch.indices)  # a few hundred bytes, not the data
+            if self._window_free:
+                # reconstruct (x, y) on device from the resident raw
+                # series: index -> target timestep -> target + offsets
+                x, y = gather_window_batch(
+                    self._resident_series(batch.city),
+                    self._resident_targets(mode, batch.city),
+                    self._offsets_device(),
+                    idx,
+                    self._horizon,
+                )
+                mask = self._mask(sample_mask, self.dataset.n_nodes + pad, pad)
+                return x, y, mask
             x_all, y_all = self._resident_arrays(mode, batch.city)
             mask = self._mask(sample_mask, y_all.shape[y_all.ndim - 2], pad)
-            idx = jnp.asarray(batch.indices)  # a few hundred bytes, not the data
             return jnp.take(x_all, idx, axis=0), jnp.take(y_all, idx, axis=0), mask
         mask = self._mask(sample_mask, batch.y.shape[batch.y.ndim - 2] + pad, pad)
         bx, by = batch.x, batch.y
@@ -664,7 +736,9 @@ class Trainer:
         )
 
     def _resident_arrays(self, mode: str, city: int):
-        """Device copies of a mode's full (x, y), uploaded once per run."""
+        """Device copies of a mode's full (x, y), uploaded once per run
+        (the materialized resident path; the window-free path keeps only
+        :meth:`_resident_series` + :meth:`_resident_targets`)."""
         key = (mode, city)
         if key not in self._resident_cache:
             x, y = (
@@ -681,6 +755,44 @@ class Trainer:
                 self.placement.put(y, "y"),
             )
         return self._resident_cache[key]
+
+    def _resident_series(self, city: int):
+        """Device copy of the raw normalized series, uploaded once per run.
+
+        ONE ``(T, N, C)`` tensor serves every mode's batches (the modes
+        are target-index ranges over it) — this is where the window-free
+        path's ~``seq_len``x memory saving lives. Node padding is applied
+        to the series once; gathered windows come out pre-padded.
+        """
+        if city not in self._resident_series_cache:
+            s = (
+                self.dataset.series_stack()
+                if self.dataset.shared_graphs
+                else self.dataset.series(city)
+            )
+            pad = self._pad_for(city)
+            if pad:
+                s = self._pad_nodes(s, 1, pad)
+            self._resident_series_cache[city] = self.placement.put(s, "x")
+        return self._resident_series_cache[city]
+
+    def _resident_targets(self, mode: str, city: int):
+        """Device int32 target-timestep vector for a mode's samples."""
+        key = (mode, city)
+        if key not in self._resident_targets_cache:
+            t = self.dataset.mode_targets(
+                mode, None if self.dataset.shared_graphs else city
+            )
+            self._resident_targets_cache[key] = self.placement.put(t, "x")
+        return self._resident_targets_cache[key]
+
+    def _offsets_device(self):
+        """Device copy of the window's gather-offset table."""
+        if self._offsets_dev is None:
+            self._offsets_dev = self.placement.put(
+                np.asarray(self.dataset.window.offsets, np.int32), "x"
+            )
+        return self._offsets_dev
 
     def _pad_nodes(self, arr, axis: int, pad: int):
         widths = [(0, 0)] * arr.ndim
@@ -864,9 +976,8 @@ class Trainer:
         per-step (a zero-real padded scan step would divide 0/0 in the
         loss and poison the Adam moments — parity forbids it)."""
         S = self.steps_per_superstep
-        x_all, y_all = self._resident_arrays(mode, 0)
-        n_nodes = y_all.shape[y_all.ndim - 2]
         pad = self._pad_for(0)
+        n_nodes = self.dataset.n_nodes + pad
         blocks = []
         for i in range(len(batches) // S):
             chunk = batches[i * S:(i + 1) * S]
@@ -903,8 +1014,28 @@ class Trainer:
         if self._superstep_fns is None:
             self._superstep_fns = self._make_superstep_fns()
         S = self.steps_per_superstep
-        x_all, y_all = self._resident_arrays(mode, 0)
         sup = self.supports
+        if self._window_free:
+            # the fused program gathers each microbatch from the resident
+            # series (series superstep); resident operands here are the
+            # series + this mode's targets + the offset table
+            series = self._resident_series(0)
+            targets = self._resident_targets(mode, 0)
+            offsets = self._offsets_device()
+
+            def dispatch(idx_d, mask_d):
+                return self._superstep_fns.train_superstep(
+                    self.params, self.opt_state, sup, series, targets,
+                    offsets, idx_d, mask_d,
+                )
+        else:
+            x_all, y_all = self._resident_arrays(mode, 0)
+
+            def dispatch(idx_d, mask_d):
+                return self._superstep_fns.train_superstep(
+                    self.params, self.opt_state, sup, x_all, y_all,
+                    idx_d, mask_d,
+                )
         batches = list(self.dataset.batches(
             mode, self.batch_size, shuffle=self.shuffle, seed=self.seed,
             epoch=self.epoch, pad_last=True, with_arrays=False,
@@ -951,11 +1082,7 @@ class Trainer:
                     jax.tree.map(jnp.copy, self.params),
                     jax.tree.map(jnp.copy, self.opt_state),
                 )
-            self.params, self.opt_state, loss_vec = (
-                self._superstep_fns.train_superstep(
-                    self.params, self.opt_state, sup, x_all, y_all, idx_d, mask_d
-                )
-            )
+            self.params, self.opt_state, loss_vec = dispatch(idx_d, mask_d)
             # superstep i is dispatched; upload block i+1 under its compute
             placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
             if guard is not None and not np.isfinite(np.asarray(loss_vec)).all():

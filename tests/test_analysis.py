@@ -735,3 +735,103 @@ class TestServingBucketRule:
         from stmgcn_tpu.analysis import check_serving_buckets
 
         assert check_serving_buckets([("none", object())]) == []
+
+
+class TestResidentMemoryRule:
+    """Pass 2f: the resident-memory footprint contract (pure config math
+    — the same arithmetic as DemandDataset.resident_nbytes/nbytes,
+    checked against Trainer.RESIDENT_CAP_BYTES at lint time)."""
+
+    def test_rule_registered_as_error(self):
+        assert RULES["resident-memory"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_resident_memory
+
+        assert check_resident_memory() == []
+
+    def test_estimate_matches_dataset_math(self):
+        """The config-only estimate equals the smoke preset's real
+        dataset footprints, byte for byte (window-free 4.5x smaller)."""
+        from stmgcn_tpu.analysis.resident_check import estimate_resident_bytes
+        from stmgcn_tpu.config import preset
+        from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+
+        cfg = preset("smoke")
+        est = estimate_resident_bytes(cfg)
+        assert (est["series_bytes"], est["materialized_bytes"]) == (270836, 1209600)
+        data = synthetic_dataset(rows=cfg.data.rows,
+                                 n_timesteps=cfg.data.n_timesteps)
+        ds = DemandDataset(
+            data, WindowSpec(cfg.data.serial_len, cfg.data.daily_len,
+                             cfg.data.weekly_len, cfg.data.day_timesteps)
+        )
+        assert est["series_bytes"] == ds.resident_nbytes
+        assert est["materialized_bytes"] == ds.nbytes
+
+    def test_budget_margin_is_the_documented_boundary(self):
+        """At N=2500 the window-free footprint crosses the 1 GiB budget
+        between T=107331 (3,152 bytes inside) and T=107332 — the check
+        must know the boundary exactly, like collective-shape's 150-vs-156
+        halo margin."""
+        from stmgcn_tpu.analysis.resident_check import (
+            check_resident_memory, estimate_resident_bytes,
+        )
+        from stmgcn_tpu.config import preset
+        from stmgcn_tpu.train.trainer import Trainer
+
+        assert Trainer.RESIDENT_CAP_BYTES == 1 << 30
+        cfg = preset("smoke")
+        cfg.train.data_placement = "resident"
+        cfg.data.rows = 50
+
+        cfg.data.n_timesteps = 107331
+        assert estimate_resident_bytes(cfg)["series_bytes"] == 1073738672
+        assert check_resident_memory([("edge", cfg)]) == []
+
+        cfg.data.n_timesteps = 107332
+        f = check_resident_memory([("over", cfg)])
+        assert [x.rule for x in f] == ["resident-memory"]
+        assert f[0].severity == "error"
+        assert f[0].path == "<contract:resident:over>"
+        assert "window-free series" in f[0].message
+
+    def test_materialized_fallback_fires_with_hint(self):
+        """window_free=False forces the ~seq_len-x materialized windows:
+        a config whose series fits but whose windows do not must fire and
+        say the window-free path would have fit."""
+        from stmgcn_tpu.analysis import check_resident_memory
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("smoke")
+        cfg.train.data_placement = "resident"
+        cfg.train.window_free = False
+        cfg.data.rows = 50
+        cfg.data.n_timesteps = 30000
+        f = check_resident_memory([("mat", cfg)])
+        assert any("materialized windows" in x.message for x in f)
+        assert any("window-free series would be" in x.message for x in f)
+        cfg.train.window_free = None  # the default path fits fine
+        assert check_resident_memory([("wf", cfg)]) == []
+
+    def test_resident_on_mesh_fires(self):
+        from stmgcn_tpu.analysis import check_resident_memory
+        from stmgcn_tpu.config import preset
+
+        bad = preset("multicity")  # dp=8 mesh
+        bad.train.data_placement = "resident"
+        f = check_resident_memory([("bad", bad)])
+        assert [x.rule for x in f] == ["resident-memory"]
+        assert any("mesh" in x.message for x in f)
+
+    def test_auto_placement_skipped(self):
+        """"auto" degrades to streaming by design — an oversized auto
+        config must not fire."""
+        from stmgcn_tpu.analysis import check_resident_memory
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("smoke")
+        cfg.data.rows = 50
+        cfg.data.n_timesteps = 500000  # far past the budget
+        assert cfg.train.data_placement == "auto"
+        assert check_resident_memory([("big", cfg)]) == []

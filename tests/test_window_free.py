@@ -1,0 +1,206 @@
+"""Window-free resident data: on-device gather parity and plumbing.
+
+The window-free path keeps ONE normalized ``(T, N, C)`` series resident
+per city (plus int32 target/offset vectors) and reconstructs every
+microbatch inside the jitted step by pure index copies
+(``train/step.py gather_window_batch``) — no window arrays are ever
+materialized. Because the gather is index arithmetic with no float math,
+parity against the materialized-window oracle is exact equality, not
+allclose: per-batch losses, histories, params, and opt-state must match
+bit for bit across shuffle on/off, per-step/superstep dispatch, horizon
+1 and H>1, padded tail batches, and a SIGTERM mid-epoch resume.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.resilience import FaultPlan, FaultSpec, Preempted
+from stmgcn_tpu.train import Trainer
+
+BATCH = 8
+
+
+def build(out_dir, *, window_free=None, horizon=1, shuffle=False, superstep=1,
+          epochs=2, placement="resident", **kw):
+    data = synthetic_dataset(rows=5, n_timesteps=24 * 7 * 2 + 60, seed=1)
+    dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24, horizon=horizon))
+    sup = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                   horizon=horizon, lstm_hidden_dim=8, lstm_num_layers=1,
+                   gcn_hidden_dim=8)
+    return Trainer(model, dataset, sup, n_epochs=epochs, batch_size=BATCH,
+                   shuffle=shuffle, steps_per_superstep=superstep,
+                   data_placement=placement, window_free=window_free,
+                   out_dir=str(out_dir), verbose=False, **kw)
+
+
+def same(a, b):
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+class TestTrainerParity:
+    """window_free=True vs the materialized oracle (window_free=False):
+    bit-identical histories and final state, with the window-free run
+    proving it never built a window array."""
+
+    @pytest.mark.parametrize("shuffle,superstep,horizon", [
+        (False, 1, 1),
+        (True, 3, 1),
+        (False, 3, 4),
+        pytest.param(True, 1, 4, marks=pytest.mark.slow),
+    ])
+    def test_bit_identical_to_materialized(self, tmp_path, shuffle, superstep,
+                                           horizon):
+        wf = build(tmp_path / "wf", window_free=True, shuffle=shuffle,
+                   superstep=superstep, horizon=horizon)
+        oracle = build(tmp_path / "mat", window_free=False, shuffle=shuffle,
+                       superstep=superstep, horizon=horizon)
+        assert wf._window_free and not oracle._window_free
+
+        wf_hist = wf.train()
+        oracle_hist = oracle.train()
+
+        # the window-free run must never have materialized windows; the
+        # oracle must have (that's what makes it the oracle)
+        assert not wf.dataset.materialized
+        assert oracle.dataset.materialized
+
+        # coverage precondition: the epoch ends in a padded tail batch
+        tail = list(
+            wf.dataset.batches("train", BATCH, pad_last=True, with_arrays=False)
+        )[-1]
+        assert tail.n_real < BATCH
+
+        np.testing.assert_array_equal(wf_hist["train"], oracle_hist["train"])
+        np.testing.assert_array_equal(
+            wf_hist["validate"], oracle_hist["validate"]
+        )
+        same(wf.params, oracle.params)
+        same(jax.tree.leaves(wf.opt_state), jax.tree.leaves(oracle.opt_state))
+
+    def test_default_is_window_free_when_resident(self, tmp_path):
+        tr = build(tmp_path)  # window_free=None, resident placement
+        assert tr._resident and tr._window_free
+
+    def test_streaming_placement_refuses_window_free(self, tmp_path):
+        with pytest.raises(ValueError, match="resident"):
+            build(tmp_path, window_free=True, placement="stream")
+        # but auto window-free just degrades with the placement
+        tr = build(tmp_path / "s", placement="stream")
+        assert not tr._window_free
+
+    def test_hetero_dataset_refuses_window_free(self, tmp_path):
+        from stmgcn_tpu.config import preset
+        from stmgcn_tpu.experiment import build_trainer
+
+        cfg = preset("multicity")
+        cfg.data.city_rows = (4, 3)
+        cfg.data.city_timesteps = (24 * 7 * 2 + 24, 24 * 7 * 2)
+        cfg.mesh.dp = 1
+        cfg.train.window_free = True
+        cfg.train.out_dir = str(tmp_path)
+        with pytest.raises(ValueError, match="homogeneous"):
+            build_trainer(cfg, verbose=False)
+
+
+def test_cli_and_config_plumbing():
+    from stmgcn_tpu.cli import build_parser, config_from_args
+
+    p = build_parser()
+    assert config_from_args(p.parse_args([])).train.window_free is None
+    wf = config_from_args(p.parse_args(["--window-free"]))
+    assert wf.train.window_free is True
+    mat = config_from_args(p.parse_args(["--no-window-free"]))
+    assert mat.train.window_free is False
+
+
+class TestWindowFreeResume:
+    """Mid-epoch SIGTERM on the window-free path: resume must end
+    bit-identical to the uninterrupted window-free run (same drill as
+    test_resilience.TestResumeParity, on the new data path)."""
+
+    @pytest.mark.parametrize("shuffle,superstep", [
+        (False, 1),
+        pytest.param(True, 3, marks=pytest.mark.slow),
+    ])
+    def test_sigterm_resume_bit_exact(self, tmp_path, shuffle, superstep):
+        ref = build(tmp_path / "ref", window_free=True, shuffle=shuffle,
+                    superstep=superstep)
+        ref_hist = ref.train()
+
+        plan = FaultPlan(FaultSpec("sigterm", epoch=2, step=4))
+        faulted = build(tmp_path / "run", window_free=True, fault_plan=plan,
+                        shuffle=shuffle, superstep=superstep)
+        with pytest.raises(Preempted, match="--resume auto"):
+            faulted.train()
+
+        resumed = build(tmp_path / "run", window_free=True, shuffle=shuffle,
+                        superstep=superstep)
+        meta = resumed.restore_auto()
+        assert meta is not None
+        assert meta["epoch"] == 2 and meta["batch_in_epoch"] > 0
+        hist = resumed.train()
+
+        assert resumed._window_free and not resumed.dataset.materialized
+        same(ref.params, resumed.params)
+        same(jax.tree.leaves(ref.opt_state), jax.tree.leaves(resumed.opt_state))
+        assert hist["train"][-1] == ref_hist["train"][-1]
+        assert hist["validate"][-1] == ref_hist["validate"][-1]
+
+
+class TestWindowFreeData:
+    """Dataset-level contracts behind the trainer path: gather-index
+    parity with the materialized arrays, laziness, and the footprint."""
+
+    @pytest.mark.parametrize("n_cities,horizon", [(1, 1), (2, 3)])
+    def test_mode_targets_reconstruct_arrays(self, n_cities, horizon):
+        datas = [
+            synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 40, seed=c)
+            for c in range(n_cities)
+        ]
+        ds = DemandDataset(
+            datas if n_cities > 1 else datas[0],
+            WindowSpec(4, 1, 1, 24, horizon=horizon),
+        )
+        stack = ds.series_stack()
+        offsets = ds.window.offsets
+        for mode in ("train", "validate", "test"):
+            x, y = ds.arrays(mode)
+            tgt = ds.mode_targets(mode)
+            np.testing.assert_array_equal(x, stack[tgt[:, None] + offsets])
+            if horizon == 1:
+                np.testing.assert_array_equal(y, stack[tgt])
+            else:
+                np.testing.assert_array_equal(
+                    y, stack[tgt[:, None] + np.arange(horizon)]
+                )
+            for c in range(n_cities):
+                xc, yc = ds.city_arrays(mode, c)
+                tc = ds.mode_targets(mode, city=c)
+                np.testing.assert_array_equal(
+                    xc, ds.series(c)[tc[:, None] + offsets]
+                )
+
+    def test_index_batches_never_materialize(self):
+        data = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 40, seed=0)
+        ds = DemandDataset(data, WindowSpec(4, 1, 1, 24))
+        batches = list(ds.batches("train", BATCH, pad_last=True,
+                                  with_arrays=False))
+        assert batches and not ds.materialized
+        assert all(b.x is None for b in batches)
+        ds.arrays("train")  # the materialized path still works on demand
+        assert ds.materialized
+
+    def test_resident_footprint_math(self):
+        data = synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 40, seed=0)
+        ds = DemandDataset(data, WindowSpec(10, 1, 1, 24))
+        # the window-free footprint is the acceptance-level >=4x smaller,
+        # and the analytic nbytes equals the real materialized bytes
+        assert ds.nbytes >= 4 * ds.resident_nbytes
+        ds.materialize()
+        real = sum(a.nbytes for a in ds._xs) + sum(a.nbytes for a in ds._ys)
+        assert ds.nbytes == real

@@ -52,10 +52,13 @@ from typing import Optional
 # slow hosts without changing the canonical TPU operating point.
 #: "canonical" measures the 16x16 flagship point; "scaled" measures
 #: BASELINE config 3 (50x50 grid -> N=2500, K=3, bf16, batch 16) as a
-#: dense-vs-sparse support-representation table on one chip. Scaled runs
-#: persist their own last-good TPU evidence
-#: (benchmarks/tpu_scaled_last_good.json), which canonical records embed
-#: as ``scaled_tpu`` so the driver-captured record carries both stories.
+#: dense-vs-sparse support-representation table on one chip. "fleet"
+#: measures an 8-city heterogeneous fleet (two shape classes) as a
+#: fused-fleet-superstep vs materialized-per-city-loop epoch-throughput
+#: table on one chip. Scaled/fleet runs persist their own last-good TPU
+#: evidence (benchmarks/tpu_{scaled,fleet}_last_good.json), which
+#: canonical records embed as ``scaled_tpu`` so the driver-captured
+#: record carries both stories.
 MODE = os.environ.get("STMGCN_BENCH_MODE", "canonical")
 ROWS = int(os.environ.get("STMGCN_BENCH_ROWS", 16))
 SERIAL, DAILY, WEEKLY = 10, 1, 1
@@ -80,6 +83,9 @@ LSTM_BACKEND = os.environ.get("STMGCN_BENCH_LSTM_BACKEND", "xla")
 #: pure dispatch amortization. Overriding moves the run off the canonical
 #: point (it changes what the superstep leg measures).
 SUPERSTEP = int(os.environ.get("STMGCN_BENCH_SUPERSTEP", 8))
+#: S for the fleet superstep (fleet mode): fused steps per dispatch on
+#: the per-class path. Overriding moves the run off the canonical point.
+FLEET_S = int(os.environ.get("STMGCN_BENCH_FLEET_S", 8))
 CUSTOM_SCHEDULE = (
     "STMGCN_BENCH_LSTM_UNROLL" in os.environ
     or "STMGCN_BENCH_LSTM_FUSED" in os.environ
@@ -465,6 +471,199 @@ def _measure_scaled(sparse: bool, warmup: int, iters: int) -> dict:
     return leg
 
 
+#: the fleet operating point: 8 heterogeneous cities in two shape
+#: classes at the default waste budget — six cities share the N=16 rung
+#: (worst member N=14 pads 2/16 of its nodes), two share the N=6 rung
+#: exactly. Near-equal member sizes keep rung-padding overcompute small,
+#: so the fleet-vs-loop ratio measures what bucketing actually buys
+#: (program count + dispatch amortization), not pad arithmetic.
+FLEET_CITY_DIMS = (
+    (4, 4), (4, 4), (5, 3), (3, 5), (7, 2), (2, 7), (3, 2), (2, 3)
+)
+
+#: short serial window for the fleet legs (the canonical point keeps
+#: SERIAL=10): a slim forward keeps per-step device compute small so the
+#: measured ratio isolates dispatch/loop overhead — the cost the fleet
+#: path exists to amortize
+FLEET_SERIAL = 3
+
+
+def _build_fleet_trainer(out_dir: str, *, superstep: int, fleet, window_free):
+    """One 8-city heterogeneous trainer at the fleet operating point.
+
+    Slim hidden dims for the same reason as serve-bench's throwaway
+    model: the fleet path's win is dispatch amortization (one fused
+    program per class instead of a per-city per-step loop), and tiny
+    forwards are the regime where dispatch dominates."""
+    from stmgcn_tpu.data import HeteroCityDataset, WindowSpec, synthetic_dataset
+    from stmgcn_tpu.models import STMGCN
+    from stmgcn_tpu.ops import SupportConfig
+    from stmgcn_tpu.train import CitySupports, Trainer
+
+    datas = [
+        synthetic_dataset(rows=r, cols=c, n_timesteps=24 * 7 * 4 + 12 * i,
+                          seed=i + 1)
+        for i, (r, c) in enumerate(FLEET_CITY_DIMS)
+    ]
+    dataset = HeteroCityDataset(
+        datas, WindowSpec(FLEET_SERIAL, DAILY, WEEKLY, 24)
+    )
+    sup = CitySupports(
+        SupportConfig("chebyshev", 2).build_all(d.adjs.values()) for d in datas
+    )
+    # slim hidden dims + small batches: the dispatch-dominated regime
+    # (serve-bench's throwaway-model rationale) — per-step compute is
+    # microseconds, per-step host round-trips are what the per-city loop
+    # dies on, and the fused per-class scan removes S of them at a time
+    model = STMGCN(
+        m_graphs=3, n_supports=3, seq_len=FLEET_SERIAL + DAILY + WEEKLY,
+        input_dim=1, horizon=1, lstm_hidden_dim=8, lstm_num_layers=1,
+        gcn_hidden_dim=8,
+    )
+    return Trainer(
+        model, dataset, sup, n_epochs=1, batch_size=2,
+        steps_per_superstep=superstep, fleet=fleet,
+        window_free=window_free, out_dir=out_dir, verbose=False,
+    )
+
+
+def _fleet_leg(trainer, epochs: int) -> dict:
+    """Epoch-throughput of one training path: one warmup epoch (compiles
+    every program the path needs), then ``epochs`` timed epochs. The
+    epoch's final loss reduction reads back on host, so each epoch is
+    naturally fenced. Throughput counts REAL demand points — samples x
+    seq_len x the city's real node count — so padded rungs never inflate
+    the fleet leg's numerator."""
+    seq_len = FLEET_SERIAL + DAILY + WEEKLY
+    work = sum(
+        len(trainer.dataset.mode_targets("train", c)) * seq_len
+        * trainer.dataset.city_n_nodes[c]
+        for c in range(trainer.dataset.n_cities)
+    )
+    trainer._run_epoch("train", True)  # warmup: compile + first dispatches
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        loss = trainer._run_epoch("train", True)
+    epoch_s = (time.perf_counter() - t0) / epochs
+    return {
+        "value": round(work / epoch_s, 1),
+        "epoch_ms": round(epoch_s * 1e3, 1),
+        "final_loss": round(float(loss), 6),
+        "train_path": trainer.train_path,
+        "fallback_reason": trainer.fallback_reason,
+    }
+
+
+def _fleet_main(probe_err, native_tpu, lock, load_before) -> None:
+    """Fleet-mode record: the fused per-class superstep vs the
+    materialized per-city loop on the same 8-city fleet.
+
+    Both trainers consume identical data with identical math (the loop
+    IS the fleet path's bit-parity oracle, tests/test_fleet.py), so the
+    throughput ratio isolates what shape-class bucketing buys: one
+    compiled program per class + S fused steps per dispatch, against one
+    program per city dispatched per step."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from stmgcn_tpu.utils.hostload import is_contended
+
+    results, measure_err = {}, None
+    epochs = 3 if native_tpu else 1
+    tmp = tempfile.mkdtemp(prefix="stmgcn_fleet_bench_")
+    plan = None
+    try:
+        for name, kwargs in (
+            ("fleet_superstep", dict(superstep=FLEET_S, fleet=None,
+                                     window_free=None)),
+            ("per_city_loop", dict(superstep=1, fleet=False,
+                                   window_free=False)),
+        ):
+            try:
+                t = _build_fleet_trainer(
+                    os.path.join(tmp, name), **kwargs
+                )
+                if name == "fleet_superstep":
+                    plan = t._fleet_plan
+                results[name] = _fleet_leg(t, epochs)
+            except Exception as e:
+                measure_err = f"{name}: {type(e).__name__}: {e}"
+                print(f"bench: fleet measurement failed for {measure_err}",
+                      file=sys.stderr)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if not results:
+        raise RuntimeError(measure_err or "no fleet configuration measured")
+
+    host_load = _provenance(lock, load_before)
+    contended = is_contended(host_load)
+    fast = results.get("fleet_superstep")
+    slow = results.get("per_city_loop")
+    record = {
+        "metric": "region-timesteps/sec/chip",
+        "operating_point": "fleet-8city",
+        "value": (fast or slow)["value"],
+        "unit": "region-timesteps/s",
+        # the torch anchor exists only at the canonical 16x16 point; this
+        # record's comparison axis is fused-fleet vs per-city loop
+        "vs_baseline": None,
+        "fleet_vs_per_city": (
+            round(fast["value"] / slow["value"], 2) if fast and slow else None
+        ),
+        "s_steps": FLEET_S,
+        "n_cities": len(FLEET_CITY_DIMS),
+        "shape_classes": (
+            [
+                {
+                    "n_nodes": c.n_nodes,
+                    "cities": list(c.cities),
+                    "node_waste": round(c.node_waste, 4),
+                }
+                for c in plan.classes
+            ]
+            if plan is not None
+            else None
+        ),
+        "pad_waste": round(plan.node_waste, 4) if plan is not None else None,
+        "device": jax.devices()[0].device_kind,
+        "variants": results,
+        "host_load": host_load,
+        "contended": contended,
+    }
+    if probe_err is not None:
+        record["platform"] = "cpu-fallback"
+        record["error"] = probe_err
+    elif measure_err is not None:
+        record["error"] = measure_err
+    path = os.path.join(BENCH_DIR, "tpu_fleet_last_good.json")
+    if (
+        native_tpu
+        and len(results) == 2
+        and measure_err is None
+        and CANONICAL_POINT
+        and lock.acquired
+        and not contended
+    ):
+        # same host-contention policy as the canonical/scaled snapshots:
+        # only a clean on-chip table at the shipped operating point,
+        # measured while holding the bench lock with no competing
+        # process, becomes last-good evidence
+        snapshot = dict(record)
+        snapshot["captured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        snapshot["measurement"] = {"epochs": epochs}
+        try:
+            with open(path, "w") as f:
+                json.dump(snapshot, f, indent=1)
+        except OSError as e:
+            print(f"bench: could not persist fleet last-good: {e}",
+                  file=sys.stderr)
+    _emit(record)
+
+
 def _scaled_main(probe_err, native_tpu, lock, load_before) -> None:
     """Scaled-mode record: dense vs block-CSR sparse at BASELINE config 3.
 
@@ -540,9 +739,9 @@ def _scaled_main(probe_err, native_tpu, lock, load_before) -> None:
 
 
 def main() -> None:
-    if MODE not in ("canonical", "scaled"):
+    if MODE not in ("canonical", "scaled", "fleet"):
         raise SystemExit(
-            f"STMGCN_BENCH_MODE must be canonical|scaled, got {MODE!r}"
+            f"STMGCN_BENCH_MODE must be canonical|scaled|fleet, got {MODE!r}"
         )
     if DTYPE not in ("float32", "bfloat16", "both"):
         raise SystemExit(
@@ -589,6 +788,9 @@ def main() -> None:
     native_tpu = probe_err is None and probed_backend == "tpu"
     if MODE == "scaled":
         _scaled_main(probe_err, native_tpu, lock, load_before)  # emits + exits
+        return
+    if MODE == "fleet":
+        _fleet_main(probe_err, native_tpu, lock, load_before)  # emits + exits
         return
     if CUSTOM_SCHEDULE:
         if LSTM_BACKEND == "pallas" and not native_tpu:

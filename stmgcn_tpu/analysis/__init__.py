@@ -25,14 +25,18 @@ by tier-1 (``tests/test_analysis.py``):
   preset (ppermute halo rows vs shard size, batch vs dp, m_graphs vs
   branch), resident-memory math for every preset (window-free series vs
   materialized-window footprint vs the per-core budget,
-  :mod:`.resident_check`), and serving bucket-ladder math for every
-  preset (strictly increasing, covers max_batch, pad waste bounded).
+  :mod:`.resident_check`), fleet shape-class math for every preset that
+  engages the fleet path (planner knobs, city coverage, per-class
+  resident footprint, :mod:`.fleet_check`), and serving bucket-ladder
+  math for every preset (strictly increasing, covers max_batch, pad
+  waste bounded).
 
 Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 ``# stmgcn: ignore``) on the offending line.
 """
 
 from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
 from stmgcn_tpu.analysis.report import Finding, render_json, render_text
@@ -46,6 +50,7 @@ __all__ = [
     "RULES",
     "Rule",
     "check_collective_contracts",
+    "check_fleet_shape_classes",
     "check_partition_specs",
     "check_resident_memory",
     "check_serving_buckets",

@@ -96,6 +96,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # force CPU *before* the contract pass initializes the backend —
         # lint must never queue on (or wake) an accelerator
         from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+        from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
         from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
         from stmgcn_tpu.analysis.resident_check import check_resident_memory
         from stmgcn_tpu.analysis.serving_check import check_serving_buckets
@@ -106,6 +107,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_partition_specs())
         findings.extend(check_collective_contracts())
         findings.extend(check_resident_memory())
+        findings.extend(check_fleet_shape_classes())
         findings.extend(check_serving_buckets())
         findings.extend(check_step_contracts(args.preset))
     elif not args.paths:

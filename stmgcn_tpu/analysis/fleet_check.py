@@ -1,0 +1,208 @@
+"""Pass 2g: fleet shape-class contracts — static planner math.
+
+The fleet fast path (``train/trainer.py``, ``serving/fleet.py``) groups
+heterogeneous cities into shape classes so one compiled superstep per
+class replaces the per-city materialized loop. Whether a preset's fleet
+*plan* is viable is pure config arithmetic, the same way the
+resident-memory pass re-derives footprints: the planner
+(:func:`stmgcn_tpu.data.fleet.plan_shape_classes`) is deterministic in
+the config's city sizes and knobs, so this pass re-runs it host-side
+and flags configurations whose requested fleet path cannot hold:
+
+- **invalid knobs** — ``fleet_max_classes < 1`` or ``fleet_max_pad_waste``
+  outside ``[0, 1)`` (the planner raises at trainer construction);
+- **fleet on a homogeneous dataset** — ``fleet=True`` with one shape
+  (the trainer rejects it: there is nothing to bucket);
+- **uncovered cities** — cities the class budget cannot cover within the
+  pad-waste threshold silently keep the per-step fallback, so the
+  requested speedup quietly evaporates for them;
+- **per-class resident footprint** — the class's concatenated series +
+  target vectors + stacked dense supports at the rung must fit the
+  per-core budget (the conservative ``Trainer.RESIDENT_CAP_BYTES``
+  floor), else the run OOMs (``resident``) or degrades to streaming and
+  off the fleet path entirely (``auto``).
+
+No data build, no trace.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_fleet_shape_classes", "estimate_fleet_plan"]
+
+#: synthetic demand channels and the pipeline's storage dtype — keep in
+#: lockstep with resident_check.py
+_CHANNELS = 1
+_ITEMSIZE = 4
+
+
+def _fleet_engaged(cfg) -> bool:
+    t = cfg.train
+    return t.fleet is True or (t.fleet is None and t.steps_per_superstep > 1)
+
+
+def _city_sizes(cfg) -> Optional[list]:
+    """Per-city *padded* node counts (planner input), or ``None`` when the
+    preset is homogeneous (no fleet to plan)."""
+    from stmgcn_tpu.experiment import node_pad_target
+
+    d = cfg.data
+    if d.city_rows is None or max(1, d.n_cities) <= 1:
+        return None
+    nodes = [r * r for r in d.city_rows]
+    if len(set(nodes)) <= 1 and not d.hetero:
+        return None
+    padded = []
+    for n in nodes:
+        target = node_pad_target(cfg, n)
+        padded.append(target if target is not None else n)
+    return padded
+
+
+def estimate_fleet_plan(cfg):
+    """Re-derive a preset's fleet plan and per-class resident bytes.
+
+    Returns ``(plan, class_bytes)`` where ``class_bytes[i]`` is class
+    ``i``'s device-resident payload — the time-concatenated member series
+    at the rung, the int32 target vectors, and the ``(members, M, K,
+    rung, rung)`` dense support stack — or ``(None, None)`` when the
+    preset is homogeneous. Mirrors ``Trainer._fleet_series`` /
+    ``_fleet_supports`` arithmetic without building a dataset.
+    """
+    from stmgcn_tpu.data.fleet import plan_shape_classes
+    from stmgcn_tpu.data.windowing import WindowSpec
+
+    sizes = _city_sizes(cfg)
+    if sizes is None:
+        return None, None
+    t, d, m = cfg.train, cfg.data, cfg.model
+    plan = plan_shape_classes(
+        sizes,
+        max_classes=t.fleet_max_classes,
+        max_pad_waste=t.fleet_max_pad_waste,
+    )
+    spec = WindowSpec(
+        d.serial_len, d.daily_len, d.weekly_len, d.day_timesteps,
+        horizon=d.horizon,
+    )
+    if d.city_timesteps is not None:
+        steps = list(d.city_timesteps)
+    else:
+        steps = [d.n_timesteps] * len(sizes)
+    sup_entry = m.m_graphs * m.n_supports * _ITEMSIZE
+    class_bytes = []
+    for cls in plan.classes:
+        rung = cls.n_nodes
+        series = targets = 0
+        for city in cls.cities:
+            t_steps = steps[city]
+            series += t_steps * rung * _CHANNELS * _ITEMSIZE
+            targets += 4 * max(0, spec.n_samples(t_steps))
+        stack = len(cls.cities) * sup_entry * rung * rung
+        class_bytes.append(series + targets + stack)
+    return plan, class_bytes
+
+
+def check_fleet_shape_classes(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+    budget_bytes: Optional[int] = None,
+) -> List[Finding]:
+    """Validate every preset's fleet shape-class plan.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset. Pure config math — safe without a JAX backend.
+    """
+    from stmgcn_tpu.config import PRESETS
+    from stmgcn_tpu.train.trainer import Trainer
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+    if budget_bytes is None:
+        budget_bytes = Trainer.RESIDENT_CAP_BYTES
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="fleet-shape-class",
+                path=f"<contract:fleet:{name}>",
+                line=0,
+                message=message,
+                severity=RULES["fleet-shape-class"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        t = cfg.train
+        if not _fleet_engaged(cfg):
+            continue
+        explicit = t.fleet is True
+
+        if t.fleet_max_classes < 1:
+            emit(
+                name,
+                f"{name}: fleet_max_classes must be >= 1, got "
+                f"{t.fleet_max_classes} — the planner rejects it at "
+                "trainer construction",
+            )
+            continue
+        if not 0.0 <= t.fleet_max_pad_waste < 1.0:
+            emit(
+                name,
+                f"{name}: fleet_max_pad_waste must be in [0, 1), got "
+                f"{t.fleet_max_pad_waste} — the planner rejects it at "
+                "trainer construction",
+            )
+            continue
+
+        sizes = _city_sizes(cfg)
+        if sizes is None:
+            if explicit:
+                emit(
+                    name,
+                    f"{name}: fleet=True on a homogeneous dataset — there "
+                    "is nothing to bucket and the trainer rejects the "
+                    "config; drop fleet or use the plain superstep path",
+                )
+            continue
+        if explicit and t.data_placement == "stream":
+            emit(
+                name,
+                f"{name}: fleet=True with data_placement='stream' — the "
+                "fleet path requires resident class series and the "
+                "trainer rejects the combination",
+            )
+            continue
+
+        plan, class_bytes = estimate_fleet_plan(cfg)
+        if plan.unassigned:
+            emit(
+                name,
+                f"{name}: {len(plan.unassigned)} of {len(sizes)} cities "
+                f"(indices {list(plan.unassigned)}) fit no shape class "
+                f"within fleet_max_classes={t.fleet_max_classes} / "
+                f"fleet_max_pad_waste={t.fleet_max_pad_waste} — they "
+                "silently keep the per-step fallback; raise the class "
+                "budget or loosen the waste threshold",
+            )
+        for cls, nbytes in zip(plan.classes, class_bytes):
+            if nbytes > budget_bytes:
+                degrade = (
+                    "the run OOMs at the first epoch"
+                    if t.data_placement == "resident"
+                    else "placement degrades to streaming and the fleet "
+                    "path is silently lost"
+                )
+                emit(
+                    name,
+                    f"{name}: shape class N={cls.n_nodes} (cities "
+                    f"{list(cls.cities)}) needs {nbytes:,} resident bytes "
+                    f"but the per-core budget is {budget_bytes:,} — "
+                    f"{degrade}; split the class or shrink the series",
+                )
+    return findings

@@ -48,7 +48,7 @@ __all__ = [
 #: the count by seq_len), not single-op drift. Keep this a single-line
 #: literal: ``stmgcn lint --rebaseline`` rewrites it in place from the
 #: measured counts (:func:`rebaseline`).
-PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_step_checked": 3290}
+PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_fleet_superstep": 970, "serve_fleet_bucket": 270, "train_step_checked": 3290}
 
 
 def _sub_jaxprs(params: dict):
@@ -146,7 +146,9 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     from stmgcn_tpu.config import preset
     from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
     from stmgcn_tpu.serving.engine import serve_bucket_fn
+    from stmgcn_tpu.serving.fleet import fleet_bucket_fn
     from stmgcn_tpu.train import (
+        make_fleet_superstep_fns,
         make_optimizer,
         make_series_superstep_fns,
         make_step_fns,
@@ -162,6 +164,9 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     fns = make_step_fns(model, optimizer, loss=cfg.train.loss)
     sfns = make_superstep_fns(model, optimizer, loss=cfg.train.loss)
     wfns = make_series_superstep_fns(
+        model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon
+    )
+    ffns = make_fleet_superstep_fns(
         model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon
     )
 
@@ -183,6 +188,16 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     series = jax.ShapeDtypeStruct((cfg.data.n_timesteps, n, c), f32)
     targets = jax.ShapeDtypeStruct((pool,), jnp.int32)
     offsets = jax.ShapeDtypeStruct((t,), jnp.int32)
+    # the fleet superstep's per-class operands: a 2-member support stack
+    # plus per-step slot / real-node vectors and node-crossed masks (the
+    # smoke preset is homogeneous; the fleet program's contract shape is
+    # class-size-invariant the same way the scan is S-invariant)
+    members = 2
+    sup_stack = jax.ShapeDtypeStruct((members,) + np.shape(supports), f32)
+    n_arr = jax.ShapeDtypeStruct((members,), jnp.int32)
+    slot_block = jax.ShapeDtypeStruct((s_steps,), jnp.int32)
+    nr_block = jax.ShapeDtypeStruct((s_steps,), jnp.int32)
+    mask_nodes_block = jax.ShapeDtypeStruct((s_steps, b, n), f32)
 
     # one serving bucket program (a mid-ladder rung): the engine compiles
     # exactly this function per rung, so its fusion health is a serving
@@ -208,6 +223,19 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
         # same shared raw train step
         "train_series_superstep": jax.make_jaxpr(wfns.train_superstep)(
             params, opt_state, sup, series, targets, offsets, idx_block, mask_block
+        ),
+        # the per-class fleet superstep: scanned steps select the city's
+        # support stack by slot and feed the traced real-node count to
+        # the gate pooling — the heterogeneous fast path's one program
+        "train_fleet_superstep": jax.make_jaxpr(ffns.train_superstep)(
+            params, opt_state, sup_stack, series, targets, offsets,
+            idx_block, mask_nodes_block, slot_block, nr_block,
+        ),
+        # the fleet serving program: one compiled (class, bucket) pair
+        # serves every member city (per-row slot gather + traced count)
+        "serve_fleet_bucket": jax.make_jaxpr(fleet_bucket_fn(model))(
+            params, sup_stack, n_arr,
+            jax.ShapeDtypeStruct((bucket,), jnp.int32), hist_bucket,
         ),
         # the checkify-wrapped step --checkify nan actually runs (the
         # divergence-guard diagnostic path) — checked like the production

@@ -103,6 +103,15 @@ _ALL_RULES = [
         "rejected at the first epoch",
     ),
     Rule(
+        "fleet-shape-class",
+        "error",
+        "a preset's fleet shape-class plan is unviable (invalid planner "
+        "knobs, fleet=True on a homogeneous dataset or streamed data, "
+        "cities uncovered within the class/waste budget, or a class's "
+        "resident footprint over the per-core budget) — the fleet fast "
+        "path is rejected, OOMs, or silently degrades per city",
+    ),
+    Rule(
         "serving-bucket-shape",
         "error",
         "a preset's serving bucket ladder is unservable (not strictly "

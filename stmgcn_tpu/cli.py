@@ -106,6 +106,23 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_false",
                    help="force materialized window arrays (the bit-parity "
                         "oracle / streaming-hetero fallback path)")
+    p.add_argument("--fleet", dest="fleet", action="store_true", default=None,
+                   help="require fleet shape-class training: heterogeneous "
+                        "cities grouped into node-count rungs, one fused "
+                        "superstep program per class (default: auto when "
+                        "--steps-per-superstep > 1 and the dataset is viable)")
+    p.add_argument("--no-fleet", dest="fleet", action="store_false",
+                   help="never group cities into shape classes (the "
+                        "materialized per-city loop — the parity oracle)")
+    p.add_argument("--fleet-max-classes", type=_positive_int, default=None,
+                   metavar="C",
+                   help="most shape classes the fleet planner may open "
+                        "(default 8); cities fitting none run per-step")
+    p.add_argument("--fleet-max-pad-waste", type=float, default=None,
+                   metavar="F",
+                   help="max padded-node fraction of a rung a city may "
+                        "waste before it is excluded from the class "
+                        "(default 0.5)")
     p.add_argument("--normalize", choices=("minmax", "std", "none"), default=None,
                    help="demand normalization (reference parity: minmax to "
                         "[-1,1]; stats travel inside checkpoints either way)")
@@ -235,6 +252,9 @@ def config_from_args(args) -> "ExperimentConfig":
         ("out_dir", "out_dir"), ("data_placement", "data_placement"),
         ("window_free", "window_free"),
         ("steps_per_superstep", "steps_per_superstep"),
+        ("fleet", "fleet"),
+        ("fleet_max_classes", "fleet_max_classes"),
+        ("fleet_max_pad_waste", "fleet_max_pad_waste"),
         ("checkpoint_every_steps", "checkpoint_every_steps"),
         ("divergence_action", "divergence_action"),
         ("divergence_patience", "divergence_patience"),

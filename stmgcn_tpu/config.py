@@ -191,6 +191,22 @@ class TrainConfig:
     #: stack and otherwise silently falls back to per-step. Results are
     #: bit-identical either way — this is purely a dispatch-overhead knob
     steps_per_superstep: int = 1
+    #: fleet shape-class training for heterogeneous cities
+    #: (data/fleet.py): group cities by padded node count into a bounded
+    #: rung ladder so ONE fused window-free superstep program per class
+    #: covers every member (per-class support stacks + traced real-node
+    #: counts). None (default) engages automatically when
+    #: steps_per_superstep > 1 on a viable heterogeneous dataset
+    #: (resident placement, dense per-city supports); True requires it
+    #: (the Trainer raises naming the blocker otherwise); False never
+    #: engages (the materialized per-city loop — the parity oracle)
+    fleet: Optional[bool] = None
+    #: most shape classes the fleet planner may open; cities that fit
+    #: none run the per-step loop (surfaced via Trainer.fallback_reason)
+    fleet_max_classes: int = 8
+    #: max padded-node fraction of a rung a member city may waste
+    #: (rung - n > waste * rung excludes the city from that rung)
+    fleet_max_pad_waste: float = 0.5
     #: write checkpoint files from a background worker (serialization —
     #: the device->host snapshot — stays on the training thread; reads
     #: flush pending writes first)

@@ -12,6 +12,7 @@ from stmgcn_tpu.data.loader import ADJ_KEYS, DemandData, load_npz
 from stmgcn_tpu.data.normalize import MinMaxNormalizer, StdNormalizer, normalizer_from_dict
 from stmgcn_tpu.data.pipeline import DemandDataset, Batch
 from stmgcn_tpu.data.hetero import HeteroCityDataset
+from stmgcn_tpu.data.fleet import FleetPlan, ShapeClass, plan_shape_classes
 from stmgcn_tpu.data.splits import SplitSpec, date_splits
 from stmgcn_tpu.data.synthetic import synthetic_demand, grid_adjacency, synthetic_dataset
 from stmgcn_tpu.data.windowing import WindowSpec, sliding_windows
@@ -21,8 +22,10 @@ __all__ = [
     "Batch",
     "DemandData",
     "DemandDataset",
+    "FleetPlan",
     "HeteroCityDataset",
     "MinMaxNormalizer",
+    "ShapeClass",
     "StdNormalizer",
     "SplitSpec",
     "WindowSpec",
@@ -30,6 +33,7 @@ __all__ = [
     "grid_adjacency",
     "load_npz",
     "normalizer_from_dict",
+    "plan_shape_classes",
     "sliding_windows",
     "synthetic_dataset",
     "synthetic_demand",

@@ -134,6 +134,28 @@ class HeteroCityDataset:
     def n_samples(self) -> int:
         return sum(c.n_samples for c in self.cities)
 
+    # -- window-free protocol (per-city delegation) ----------------------
+    # Mirrors DemandDataset's resident-series surface so the trainer's
+    # window-free gather (and the fleet superstep built on it) treats a
+    # hetero fleet like any resident dataset — one (T, N_c, C) series per
+    # city, target vectors per (mode, city), no window materialization.
+    def series(self, city: int = 0) -> np.ndarray:
+        return self.cities[city].series(0)
+
+    def series_stack(self, city: int = 0) -> np.ndarray:
+        return self.cities[city].series_stack()
+
+    def mode_targets(self, mode: str, city: int = 0) -> np.ndarray:
+        return self.cities[city].mode_targets(mode, 0)
+
+    @property
+    def resident_nbytes(self) -> int:
+        return sum(c.resident_nbytes for c in self.cities)
+
+    @property
+    def materialized(self) -> bool:
+        return any(c.materialized for c in self.cities)
+
     # -- samples ---------------------------------------------------------
     def mode_size(self, mode: str) -> int:
         if mode not in MODES:

@@ -459,6 +459,9 @@ def build_trainer(
         data_placement=t.data_placement,
         window_free=t.window_free,
         steps_per_superstep=t.steps_per_superstep,
+        fleet=t.fleet,
+        fleet_max_classes=t.fleet_max_classes,
+        fleet_max_pad_waste=t.fleet_max_pad_waste,
         async_checkpoint=t.async_checkpoint,
         checkpoint_every_steps=t.checkpoint_every_steps,
         divergence_guard=t.divergence_guard,

@@ -151,3 +151,17 @@ class Forecaster:
         return ServingEngine.from_forecaster(
             self, supports, config=config, city=city
         )
+
+    def fleet_engine(self, city_supports, *, config=None,
+                     max_classes: int = 8, max_pad_waste: float = 0.5):
+        """A :class:`stmgcn_tpu.serving.FleetServingEngine` over this
+        heterogeneous checkpoint: every city served from one engine,
+        requests for different cities of a shape class coalescing into
+        one dispatch. Results are bit-identical to per-city
+        :meth:`predict`."""
+        from stmgcn_tpu.serving import FleetServingEngine
+
+        return FleetServingEngine.from_forecaster(
+            self, city_supports, config=config,
+            max_classes=max_classes, max_pad_waste=max_pad_waste,
+        )

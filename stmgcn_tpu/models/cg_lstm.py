@@ -54,8 +54,15 @@ class ContextualGate(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, supports, obs_seq: jnp.ndarray) -> jnp.ndarray:
-        """``obs_seq`` ``(B, T, N, C)`` -> gated ``(B, T, N, C)``."""
+    def __call__(self, supports, obs_seq: jnp.ndarray, n_real=None) -> jnp.ndarray:
+        """``obs_seq`` ``(B, T, N, C)`` -> gated ``(B, T, N, C)``.
+
+        ``n_real`` is an optional *traced* int32 real-node count: one
+        compiled program can then serve cities with differing real N
+        inside one padded shape class (fleet training/serving), where
+        the static ``n_real_nodes`` attribute would force a program per
+        city. ``None`` keeps the static-attribute behavior.
+        """
         x_seq = obs_seq.sum(axis=-1)  # collapse features (STMGCN.py:36)
         x_nt = x_seq.transpose(0, 2, 1)  # (B, N, T): history as node features
         g = make_conv(
@@ -71,7 +78,17 @@ class ContextualGate(nn.Module):
         )(supports, x_nt)
         x_hat = x_nt + g  # eq. 6 residual
         n_nodes = x_hat.shape[1]
-        if self.n_real_nodes is not None and self.n_real_nodes != n_nodes:
+        if n_real is not None:
+            # eq. 7 over real nodes only with a *traced* count; the
+            # exact-fit arm goes through the same plain mean as the
+            # unpadded model so exact-fit cities stay bit-identical to it
+            nr = jnp.asarray(n_real)
+            node_mask = (jnp.arange(n_nodes) < nr).astype(x_hat.dtype)
+            masked = (x_hat * node_mask[None, :, None]).sum(axis=1) / nr.astype(
+                x_hat.dtype
+            )
+            z = jnp.where(nr == n_nodes, x_hat.mean(axis=1), masked)
+        elif self.n_real_nodes is not None and self.n_real_nodes != n_nodes:
             # eq. 7 over real nodes only (masked mean; a static slice would
             # fight the region sharding, a broadcast-multiply does not)
             node_mask = (jnp.arange(n_nodes) < self.n_real_nodes).astype(x_hat.dtype)
@@ -117,7 +134,7 @@ class CGLSTM(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, supports, obs_seq: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, supports, obs_seq: jnp.ndarray, n_real=None) -> jnp.ndarray:
         batch, seq_len, n_nodes, n_feats = obs_seq.shape
         gated = ContextualGate(
             n_supports=self.n_supports,
@@ -131,7 +148,7 @@ class CGLSTM(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="gate",
-        )(supports, obs_seq)
+        )(supports, obs_seq, n_real)
 
         # Fold nodes into batch for the shared recurrence (STMGCN.py:47).
         folded = gated.transpose(0, 2, 1, 3).reshape(batch * n_nodes, seq_len, n_feats)

@@ -53,7 +53,7 @@ class Branch(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, supports, obs_seq: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, supports, obs_seq: jnp.ndarray, n_real=None) -> jnp.ndarray:
         rnn_out = CGLSTM(
             n_supports=self.n_supports,
             seq_len=self.seq_len,
@@ -73,7 +73,7 @@ class Branch(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="cg_lstm",
-        )(supports, obs_seq)
+        )(supports, obs_seq, n_real)
         return make_conv(
             self.support_mode,
             shard_spec=self.shard_spec,
@@ -179,11 +179,15 @@ class STMGCN(nn.Module):
         )
 
     @nn.compact
-    def __call__(self, supports_stack, obs_seq: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, supports_stack, obs_seq: jnp.ndarray, n_real=None) -> jnp.ndarray:
         """``supports_stack``: dense ``(M, K, N, N)`` array; or, when any
         branch mode is non-dense, an M-sequence whose ``m``-th entry matches
         branch ``m``'s mode — dense ``(K, N, N)`` array, K-sequence of
-        ``BlockSparse``, or ``BandedSupports``; ``obs_seq`` ``(B, T, N, C)``."""
+        ``BlockSparse``, or ``BandedSupports``; ``obs_seq`` ``(B, T, N, C)``.
+
+        ``n_real``: optional traced int32 real-node count forwarded to the
+        gate pooling (fleet shape classes share one program over cities of
+        differing real N); ``None`` keeps the static ``n_real_nodes``."""
         modes = self.branch_modes()
         all_dense = all(m == "dense" for m in modes)
         from stmgcn_tpu.parallel.banded import BandedSupports
@@ -240,18 +244,18 @@ class STMGCN(nn.Module):
             )
             branches = nn.vmap(
                 Branch,
-                in_axes=(0, None),
+                in_axes=(0, None, None),
                 out_axes=0,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 spmd_axis_name=spmd,
             )(**self._branch_kwargs(modes[0]), name="branches")
-            feats = branches(supports_stack, obs_seq)  # (M, B, N, gcn_hidden)
+            feats = branches(supports_stack, obs_seq, n_real)  # (M, B, N, gcn_hidden)
             fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
         elif not all_dense or not self.vmap_branches:
             feats = [
                 Branch(**self._branch_kwargs(modes[m]), name=f"branch_{m}")(
-                    supports_stack[m], obs_seq
+                    supports_stack[m], obs_seq, n_real
                 )
                 for m in range(self.m_graphs)
             ]
@@ -259,12 +263,12 @@ class STMGCN(nn.Module):
         else:
             branches = nn.vmap(
                 Branch,
-                in_axes=(0, None),
+                in_axes=(0, None, None),
                 out_axes=0,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
             )(**self._branch_kwargs(), name="branches")
-            feats = branches(supports_stack, obs_seq)  # (M, B, N, gcn_hidden)
+            feats = branches(supports_stack, obs_seq, n_real)  # (M, B, N, gcn_hidden)
             fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
         out = nn.Dense(
             self.horizon * self.input_dim,

@@ -9,6 +9,10 @@ Layout:
 - :mod:`.engine` — :class:`ServingEngine`: per-rung AOT programs with
   device-resident supports/params, built from a live forecaster or an
   export artifact;
+- :mod:`.fleet` — :class:`FleetServingEngine`: a ``(city -> shape
+  class)`` routing layer over per-class programs + micro-batchers, so
+  one engine serves a whole heterogeneous fleet from one checkpoint and
+  requests for different cities of a class coalesce;
 - :mod:`.microbatch` — the request queue coalescing concurrent callers
   into one dispatch (exact-fit fast path, ``max_delay_ms`` deadline);
 - :mod:`.metrics` — per-bucket p50/p95/p99 latency, queue-wait vs
@@ -21,14 +25,17 @@ Layout:
 
 from stmgcn_tpu.serving.bucketing import pad_to_bucket, smallest_covering_bucket
 from stmgcn_tpu.serving.engine import ServingEngine, serve_bucket_fn
+from stmgcn_tpu.serving.fleet import FleetServingEngine, fleet_bucket_fn
 from stmgcn_tpu.serving.metrics import EngineStats
 from stmgcn_tpu.serving.microbatch import MicroBatcher
 from stmgcn_tpu.serving.predict import serve_predict
 
 __all__ = [
     "EngineStats",
+    "FleetServingEngine",
     "MicroBatcher",
     "ServingEngine",
+    "fleet_bucket_fn",
     "pad_to_bucket",
     "serve_bucket_fn",
     "serve_predict",

@@ -14,7 +14,12 @@ Measures the three generations of the inference path on one host:
 Each timed leg reports mean/p50/p95/p99 latency and predictions/sec with
 warmup excluded; the record carries the engine's per-bucket telemetry
 (queue-wait vs device-time split, pad waste) and the two acceptance
-ratios as ``speedup``. NOT imported by ``stmgcn_tpu.serving.__init__``
+ratios as ``speedup``. A fourth generation rides in ``record["fleet"]``:
+one :class:`~stmgcn_tpu.serving.fleet.FleetServingEngine` serving a
+two-city heterogeneous view of the same checkpoint
+(:func:`fleet_forecaster`), with mixed-city concurrent clients whose
+requests coalesce into shared dispatches (``cross_city_dispatches``)
+and a per-city bit-parity spot check. NOT imported by ``stmgcn_tpu.serving.__init__``
 — the throwaway-checkpoint trainer pulls the full stack, and the
 serving package must stay lean for ``stmgcn_tpu.export``.
 
@@ -42,7 +47,13 @@ import numpy as np
 
 from stmgcn_tpu.serving.metrics import percentiles
 
-__all__ = ["main", "run_serve_bench", "train_throwaway"]
+__all__ = [
+    "fleet_forecaster",
+    "main",
+    "run_fleet_serve_bench",
+    "run_serve_bench",
+    "train_throwaway",
+]
 
 
 def _leg(samples_s: List[float], batch: int) -> dict:
@@ -118,6 +129,50 @@ def train_throwaway(rows: int = 4, epochs: int = 2, batch_size: int = 16,
     return fc, supports
 
 
+def fleet_forecaster(fc, supports):
+    """Lift the throwaway checkpoint into a two-city heterogeneous
+    forecaster for the fleet leg: the trained 4x4 grid serves as city 0
+    (N=16) and a fresh 2x7 grid (N=14) joins as city 1 — inside the
+    default waste budget, so both land in ONE shape class and their
+    requests can coalesce. The model's params are node-count agnostic
+    (GCN weights contract feature dims, supports carry N), so one
+    checkpoint legitimately serves both. Returns
+    ``(hetero_fc, per_city_supports, n_nodes)``.
+    """
+    from stmgcn_tpu.data import MinMaxNormalizer, synthetic_dataset
+    from stmgcn_tpu.inference import Forecaster
+    from stmgcn_tpu.ops import SupportConfig
+
+    cfg = fc.config
+    m = cfg.model.m_graphs
+    small = synthetic_dataset(rows=2, cols=7, n_timesteps=24 * 7 * 2 + 40,
+                              seed=2)
+    small_sup = np.asarray(
+        SupportConfig(cfg.model.kernel_type, cfg.model.K).build_all(
+            small.adjs.values()
+        ),
+        np.float32,
+    )[:m]
+    sups = [np.asarray(supports, np.float32)[:m], small_sup]
+    n_nodes = [sups[0].shape[-1], sups[1].shape[-1]]
+    normalizers = [
+        fc.normalizer if fc.normalizer is not None
+        else MinMaxNormalizer.fit(
+            np.asarray(
+                synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 40,
+                                  seed=1).demand
+            )
+        ),
+        MinMaxNormalizer.fit(np.asarray(small.demand)),
+    ]
+    hetero = Forecaster(
+        fc.model, fc.params, None, cfg,
+        {"input_dim": fc.derived["input_dim"], "n_nodes": n_nodes},
+        normalizers,
+    )
+    return hetero, sups, n_nodes
+
+
 def _microbatch_leg(engine, history_row: np.ndarray, clients: int,
                     per_client: int) -> dict:
     """N concurrent batch-1 clients hammering ``engine.predict``."""
@@ -160,6 +215,158 @@ def _microbatch_leg(engine, history_row: np.ndarray, clients: int,
         "p99_ms": pct["p99"],
         "predictions_per_sec": round(total / elapsed, 1),
     }
+
+
+def _fleet_microbatch_leg(engine, hists, clients: int,
+                          per_client: int) -> dict:
+    """N concurrent batch-1 clients split round-robin across the fleet's
+    cities (``hists`` is ``[(history, city), ...]``), all hammering ONE
+    engine — the coalescing a per-city engine cannot do. Reports the
+    usual latency/throughput stats plus how many dispatches actually
+    mixed cities in one device batch."""
+    for h, c in hists:
+        engine.predict(h, city=c)
+    for st in engine.class_stats.values():
+        st.reset()
+    cross_before = engine.cross_city_dispatches
+
+    latencies_ms: List[float] = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients + 1)
+
+    def client(i: int):
+        h, c = hists[i % len(hists)]
+        mine = []
+        barrier.wait()
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            engine.predict(h, city=c)
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            latencies_ms.extend(mine)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    for th in threads:
+        th.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for th in threads:
+        th.join()
+    elapsed = time.perf_counter() - t0
+    total = clients * per_client
+    pct = percentiles(latencies_ms)
+    return {
+        "clients": clients,
+        "requests": total,
+        "ms": pct["mean"],
+        "p50_ms": pct["p50"],
+        "p95_ms": pct["p95"],
+        "p99_ms": pct["p99"],
+        "predictions_per_sec": round(total / elapsed, 1),
+        "cross_city_dispatches": engine.cross_city_dispatches - cross_before,
+    }
+
+
+def run_fleet_serve_bench(fc, supports, *, buckets=(1, 4, 16),
+                          max_delay_ms: float = 2.0, clients: int = 16,
+                          per_client: int = 40, warmup: int = 3,
+                          iters: int = 30) -> dict:
+    """The fleet serving record: one :class:`FleetServingEngine` over a
+    two-city heterogeneous view of the throwaway checkpoint
+    (:func:`fleet_forecaster`), measured three ways — per-city naive
+    ``Forecaster.predict`` alternating cities (the no-engine floor),
+    direct per-city engine dispatch, and mixed-city concurrent clients
+    whose requests coalesce across cities within the shape class. A
+    per-city parity spot-check rides in the record so the throughput
+    claim is pinned to bit-identical outputs."""
+    from stmgcn_tpu.config import ServingConfig
+
+    hetero, sups, n_nodes = fleet_forecaster(fc, supports)
+    ladder = tuple(sorted(set(buckets)))
+    cfg = ServingConfig(
+        buckets=ladder, max_delay_ms=max_delay_ms, max_batch=ladder[-1],
+    )
+    rng = np.random.default_rng(0)
+    hists = [
+        (
+            (rng.random((1, hetero.seq_len, n, fc.derived["input_dim"]))
+             * 50).astype(np.float32),
+            city,
+        )
+        for city, n in enumerate(n_nodes)
+    ]
+
+    with hetero.fleet_engine(sups, config=cfg) as engine:
+        parity = all(
+            bool(
+                np.array_equal(
+                    hetero.predict(sups[c], h, city=c),
+                    engine.predict_direct(h, city=c),
+                )
+            )
+            for h, c in hists
+        )
+
+        legs = {}
+        calls = {"i": 0}
+
+        def naive_alternating():
+            h, c = hists[calls["i"] % len(hists)]
+            calls["i"] += 1
+            hetero.predict(sups[c], h, city=c)
+
+        legs["naive/b1-alternating"] = _leg(
+            _timed(naive_alternating, warmup, iters), 1
+        )
+
+        def direct_alternating():
+            h, c = hists[calls["i"] % len(hists)]
+            calls["i"] += 1
+            engine.predict_direct(h, city=c)
+
+        legs["engine/b1-alternating"] = _leg(
+            _timed(direct_alternating, warmup, iters), 1
+        )
+        legs["engine/microbatch-mixed-city"] = _fleet_microbatch_leg(
+            engine, hists, clients, per_client
+        )
+
+        stats = {
+            str(ci): st.snapshot()
+            for ci, st in engine.class_stats.items()
+        }
+        plan = engine.plan
+        record = {
+            "cities": {
+                "n_nodes": n_nodes,
+                "class_of": [engine.class_of(c) for c in range(len(n_nodes))],
+                "shape_classes": [
+                    {
+                        "n_nodes": cls.n_nodes,
+                        "cities": list(cls.cities),
+                        "node_waste": round(cls.node_waste, 4),
+                    }
+                    for cls in plan.classes
+                ],
+            },
+            "buckets": list(ladder),
+            "max_delay_ms": max_delay_ms,
+            "parity": parity,
+            "legs": legs,
+            "engine_stats": stats,
+            "speedup": {
+                "microbatch_vs_naive_b1": round(
+                    legs["engine/microbatch-mixed-city"][
+                        "predictions_per_sec"
+                    ]
+                    / legs["naive/b1-alternating"]["predictions_per_sec"],
+                    2,
+                ),
+            },
+        }
+    return record
 
 
 def run_serve_bench(fc, supports, *, batch: int = 16, buckets=(1, 4, 16),
@@ -278,6 +485,9 @@ def build_serve_bench_parser() -> argparse.ArgumentParser:
                    help="timed iterations per direct leg (default 30)")
     p.add_argument("--warmup", type=int, default=3,
                    help="warmup calls per leg, excluded from stats")
+    p.add_argument("--no-fleet", action="store_true",
+                   help="skip the two-city fleet-engine leg "
+                        "(record['fleet'])")
     return p
 
 
@@ -305,6 +515,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 iters=args.iters,
                 artifact_path=os.path.join(tmp, "model.stmgx"),
             )
+            if not args.no_fleet:
+                record["fleet"] = run_fleet_serve_bench(
+                    fc, supports, buckets=buckets,
+                    max_delay_ms=args.max_delay_ms, clients=args.clients,
+                    per_client=args.per_client, warmup=args.warmup,
+                    iters=args.iters,
+                )
         record["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )

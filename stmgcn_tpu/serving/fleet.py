@@ -1,0 +1,350 @@
+"""Fleet serving: one engine, many cities, per-shape-class programs.
+
+:class:`~stmgcn_tpu.serving.engine.ServingEngine` pins ONE city — its
+region count, normalizer, and support stack are baked at construction,
+so a two-city deployment runs two engines and concurrent requests for
+different cities never coalesce. :class:`FleetServingEngine` lifts that
+to a fleet: cities group into shape classes by the same rung-ladder
+planner training uses (:func:`stmgcn_tpu.data.fleet.plan_shape_classes`),
+each class owns per-batch-bucket AOT programs over a
+``(members, M, K, rung, rung)`` support stack plus its own
+micro-batcher, and a ``(city -> class)`` routing layer in front lets
+requests for *different cities of one class* coalesce into single
+dispatches (counted in :attr:`cross_city_dispatches`). One checkpoint's
+parameters sit device-resident once, shared by every program.
+
+Bit-parity contract: each coalesced row selects its city's padded
+support stack and real-node count *inside* the program (the gate
+pooling divides by the traced count; exact-fit cities take the
+plain-mean arm), normalization/denormalization touch only the city's
+real-node slice, and padded node rows are stripped before return — so
+results are bit-identical to per-city ``Forecaster.predict``, pinned in
+tests/test_fleet.py. Cities the planner leaves unassigned (pad waste
+over budget) still serve: each gets a private exact-fit class.
+
+Import-leanness contract (same as engine.py): jax/numpy only at module
+scope; the model stack loads lazily inside ``from_forecaster``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from stmgcn_tpu.serving.engine import ServingEngine
+from stmgcn_tpu.serving.metrics import EngineStats
+from stmgcn_tpu.serving.microbatch import MicroBatcher
+
+__all__ = ["FleetServingEngine", "fleet_bucket_fn"]
+
+
+def fleet_bucket_fn(model):
+    """The per-class serving program: rows carry their city's slot.
+
+    Each row gathers its city's padded support stack and real-node count
+    from the class-level operands (pure index copies) and runs the
+    eval-mode forward with the traced count feeding the gate pooling —
+    one compiled program per (class, bucket) serves every member city.
+    Traced by the jaxpr contract pass as ``serve_fleet_bucket``.
+    """
+
+    def serve_fleet_bucket(params, sup_stack, n_arr, slots, history):
+        def row(h, s):
+            sup = jnp.take(sup_stack, s, axis=0)
+            nr = jnp.take(n_arr, s)
+            return model.apply(params, sup, h[None], nr)[0]
+
+        return jax.vmap(row)(history, slots)
+
+    return serve_fleet_bucket
+
+
+class FleetServingEngine:
+    """City-routed, class-coalesced serving over one hetero checkpoint.
+
+    Build with :meth:`from_forecaster`; then::
+
+        engine = FleetServingEngine.from_forecaster(fc, city_supports)
+        pred = engine.predict(history, city=1)        # micro-batched
+        pred = engine.predict_direct(history, city=0) # bypass the queue
+        engine.class_stats[engine.class_of(1)].snapshot()
+        engine.cross_city_dispatches                  # coalescing proof
+        engine.close()
+    """
+
+    def __init__(self, plan, groups, programs, batch_buckets, normalizers,
+                 city_n, seq_len, input_dim, config):
+        #: the shape-class plan (extra exact-fit classes for unassigned
+        #: cities appear in ``groups`` only)
+        self.plan = plan
+        self._groups = tuple(groups)  # (rung, (city, ...)) per class
+        self._programs = programs  # cls_id -> {bucket: call(slots, hist)}
+        self._buckets = tuple(sorted(batch_buckets))
+        self._normalizers = list(normalizers)
+        self._city_n = list(city_n)
+        self._seq_len = seq_len
+        self._input_dim = input_dim
+        self.config = config
+        self._city_cls: dict = {}
+        self._city_slot: dict = {}
+        for ci, (rung, cities) in enumerate(self._groups):
+            for slot, c in enumerate(cities):
+                self._city_cls[c] = ci
+                self._city_slot[c] = slot
+        #: dispatches whose coalesced rows spanned >1 city — the fleet
+        #: engine's reason to exist; per-city engines can never coalesce
+        self.cross_city_dispatches = 0
+        #: per-class telemetry (bucket keys are batch rungs)
+        self.class_stats = {
+            ci: EngineStats() for ci in range(len(self._groups))
+        }
+        self._batchers = {
+            ci: MicroBatcher(
+                lambda payload, bucket, segments, k=ci: self._run_program(
+                    k, payload, bucket, segments
+                ),
+                self._buckets,
+                config.max_delay_ms,
+                self.class_stats[ci],
+            )
+            for ci in range(len(self._groups))
+        }
+        self._closed = False
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_forecaster(cls, fc, city_supports, *, config=None,
+                        max_classes: int = 8, max_pad_waste: float = 0.5
+                        ) -> "FleetServingEngine":
+        """Engine over a heterogeneous multi-city checkpoint.
+
+        ``city_supports``: one dense ``(M, K, n_c, n_c)`` stack per city
+        (a :class:`~stmgcn_tpu.train.CitySupports` or a plain sequence).
+        The checkpoint's model is rebuilt as its dense serving clone and
+        every (class, batch-bucket) pair compiled AOT with parameters and
+        the class's rung-padded support stack pinned device-resident.
+        """
+        from stmgcn_tpu.data.fleet import plan_shape_classes
+        from stmgcn_tpu.models import to_dense_serving
+
+        cfg = ServingEngine._resolve_config(
+            config if config is not None else getattr(fc.config, "serving", None)
+        )
+        if getattr(fc, "normalizers", None) is None:
+            raise ValueError(
+                "FleetServingEngine needs a heterogeneous multi-city "
+                "checkpoint (per-city normalizers) — homogeneous "
+                "checkpoints use ServingEngine"
+            )
+        n_nodes = [int(n) for n in fc.derived["n_nodes"]]
+        normalizers = list(fc.normalizers)
+        sups = (
+            list(city_supports.per_city)
+            if hasattr(city_supports, "per_city")
+            else list(city_supports)
+        )
+        if len(sups) != len(n_nodes):
+            raise ValueError(
+                f"got {len(sups)} support stacks for {len(n_nodes)} cities"
+            )
+        m = fc.config.model.m_graphs
+        model, params = to_dense_serving(fc.model, fc.params, m)
+        sups_np = []
+        for c, (s, n) in enumerate(zip(sups, n_nodes)):
+            s = np.asarray(s, dtype=np.float32)
+            want = (m, model.n_supports, n, n)
+            if s.shape != want:
+                raise ValueError(
+                    f"city {c} supports must be {want}, got {s.shape}"
+                )
+            sups_np.append(s)
+        plan = plan_shape_classes(
+            n_nodes, max_classes=max_classes, max_pad_waste=max_pad_waste
+        )
+        groups = [(sc.n_nodes, tuple(sc.cities)) for sc in plan.classes]
+        for c in plan.unassigned:  # serve everyone: private exact-fit class
+            groups.append((n_nodes[c], (c,)))
+
+        params_dev = jax.tree.map(jnp.asarray, params)
+        fn = fleet_bucket_fn(model)
+        seq_len, input_dim = fc.seq_len, fc.derived["input_dim"]
+        programs: dict = {}
+        for ci, (rung, cities) in enumerate(groups):
+            stack = np.zeros(
+                (len(cities), m, model.n_supports, rung, rung), np.float32
+            )
+            for slot, c in enumerate(cities):
+                n = n_nodes[c]
+                stack[slot, :, :, :n, :n] = sups_np[c]
+            stack_dev = jax.device_put(jnp.asarray(stack))
+            n_arr_dev = jax.device_put(
+                jnp.asarray([n_nodes[c] for c in cities], jnp.int32)
+            )
+            programs[ci] = {}
+            for b in cfg.buckets:
+                slots_struct = jax.ShapeDtypeStruct((b,), jnp.int32)
+                hist_struct = jax.ShapeDtypeStruct(
+                    (b, seq_len, rung, input_dim), jnp.float32
+                )
+                compiled = (
+                    jax.jit(fn)
+                    .lower(params_dev, stack_dev, n_arr_dev, slots_struct,
+                           hist_struct)
+                    .compile()
+                )
+                programs[ci][b] = (
+                    lambda slots, h, c_=compiled, sd=stack_dev, nd=n_arr_dev:
+                    c_(params_dev, sd, nd, slots, h)
+                )
+        return cls(plan, groups, programs, cfg.buckets, normalizers,
+                   n_nodes, seq_len, input_dim, cfg)
+
+    # -- serving --------------------------------------------------------
+
+    @property
+    def buckets(self) -> tuple:
+        return self._buckets
+
+    @property
+    def n_cities(self) -> int:
+        return len(self._city_n)
+
+    def class_of(self, city: int) -> int:
+        """The shape class a city routes to."""
+        self._check_city(city)
+        return self._city_cls[city]
+
+    def _check_city(self, city) -> None:
+        if city not in self._city_cls:
+            raise ValueError(
+                f"city must be in [0, {len(self._city_n)}), got {city}"
+            )
+
+    def _run_program(self, cls_id: int, payload: np.ndarray, bucket: int,
+                     segments) -> np.ndarray:
+        """One coalesced dispatch for a shape class.
+
+        ``segments`` is ``((offset, n_rows, (city, pre_normalized)), ...)``
+        in payload order. Normalization runs per segment over the city's
+        real-node slice only (padded node rows stay zero — the forward's
+        bit-parity precondition); the denormalized output keeps pad rows
+        for the batcher's zero-copy scatter, and ``predict`` strips them.
+        """
+        from stmgcn_tpu.serving.bucketing import pad_to_bucket
+
+        if all(pre for _, _, (_, pre) in segments):
+            batch = payload
+        else:
+            batch = payload.copy()
+            for ofs, n, (c, pre) in segments:
+                norm = self._normalizers[c]
+                if not pre and norm is not None:
+                    nc = self._city_n[c]
+                    batch[ofs:ofs + n, :, :nc, :] = norm.transform(
+                        payload[ofs:ofs + n, :, :nc, :]
+                    )
+        slots = np.zeros(bucket, np.int32)
+        for ofs, n, (c, _) in segments:
+            slots[ofs:ofs + n] = self._city_slot[c]
+        out = np.array(
+            self._programs[cls_id][bucket](slots, pad_to_bucket(batch, bucket))
+        )
+        for ofs, n, (c, _) in segments:
+            norm = self._normalizers[c]
+            if norm is not None:
+                nc = self._city_n[c]
+                out[ofs:ofs + n, ..., :nc, :] = norm.inverse(
+                    out[ofs:ofs + n, ..., :nc, :]
+                )
+        if len({c for _, _, (c, _) in segments}) > 1:
+            self.cross_city_dispatches += 1
+        return out
+
+    def _validate(self, history, city: int) -> np.ndarray:
+        self._check_city(city)
+        history = np.asarray(history, dtype=np.float32)
+        expected = (self._seq_len, self._city_n[city], self._input_dim)
+        if history.ndim != 4 or history.shape[1:] != expected:
+            raise ValueError(
+                f"history must be (B, seq_len={expected[0]}, "
+                f"n_nodes={expected[1]}, n_feats={expected[2]}) for city "
+                f"{city}, got {history.shape}"
+            )
+        return history
+
+    def _pad_city(self, history: np.ndarray, city: int) -> np.ndarray:
+        pad = self._groups[self._city_cls[city]][0] - self._city_n[city]
+        if not pad:
+            return history
+        return np.pad(history, [(0, 0), (0, 0), (0, pad), (0, 0)])
+
+    def _strip(self, out: np.ndarray, city: int) -> np.ndarray:
+        nc = self._city_n[city]
+        return out[..., :nc, :] if out.shape[-2] != nc else out
+
+    def predict(self, history, *, city: int, normalized: bool = False
+                ) -> np.ndarray:
+        """Micro-batched raw-units forecast for one city.
+
+        Concurrent callers — including callers for *other cities of the
+        same shape class* — coalesce into one dispatch. Bit-identical to
+        ``Forecaster.predict(..., city=city)`` on the same rows.
+        """
+        if self._closed:
+            raise RuntimeError("FleetServingEngine is closed")
+        h = self._pad_city(self._validate(history, city), city)
+        batcher = self._batchers[self._city_cls[city]]
+        cap = self._buckets[-1]
+        if h.shape[0] <= cap:
+            out = batcher.submit(h, tag=(city, normalized))
+        else:  # oversized batches split into ladder-top chunks
+            out = np.concatenate([
+                batcher.submit(h[i:i + cap], tag=(city, normalized))
+                for i in range(0, h.shape[0], cap)
+            ], axis=0)
+        return self._strip(out, city)
+
+    def predict_direct(self, history, *, city: int, normalized: bool = False
+                       ) -> np.ndarray:
+        """Bypass the queue: pad to the covering rung and dispatch inline
+        (same results; no coalescing)."""
+        import time
+
+        from stmgcn_tpu.serving.bucketing import smallest_covering_bucket
+
+        if self._closed:
+            raise RuntimeError("FleetServingEngine is closed")
+        h = self._pad_city(self._validate(history, city), city)
+        cls_id = self._city_cls[city]
+        cap = self._buckets[-1]
+        parts = []
+        for i in range(0, h.shape[0], cap):
+            chunk = h[i:i + cap]
+            bucket = smallest_covering_bucket(chunk.shape[0], self._buckets)
+            t0 = time.perf_counter()
+            out = self._run_program(
+                cls_id, chunk, bucket,
+                ((0, chunk.shape[0], (city, normalized)),),
+            )
+            device_ms = (time.perf_counter() - t0) * 1e3
+            self.class_stats[cls_id].record_dispatch(
+                bucket, chunk.shape[0], [0.0], device_ms
+            )
+            parts.append(out[:chunk.shape[0]])
+        out = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+        return self._strip(out, city)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            for b in self._batchers.values():
+                b.close()
+
+    def __enter__(self) -> "FleetServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

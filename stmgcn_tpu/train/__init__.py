@@ -17,10 +17,12 @@ from stmgcn_tpu.train.checkpoint import (
 )
 from stmgcn_tpu.train.metrics import MAE, MAPE, MSE, PCC, RMSE, regression_report
 from stmgcn_tpu.train.step import (
+    FleetSuperstepFns,
     SeriesSuperstepFns,
     StepFns,
     SuperstepFns,
     gather_window_batch,
+    make_fleet_superstep_fns,
     make_optimizer,
     make_series_superstep_fns,
     make_step_fns,
@@ -31,6 +33,7 @@ from stmgcn_tpu.train.trainer import CitySupports, Trainer
 __all__ = [
     "CitySupports",
     "CorruptCheckpointError",
+    "FleetSuperstepFns",
     "MAE",
     "MAPE",
     "MSE",
@@ -43,6 +46,7 @@ __all__ = [
     "gather_window_batch",
     "load_checkpoint",
     "load_latest_verified",
+    "make_fleet_superstep_fns",
     "make_optimizer",
     "make_series_superstep_fns",
     "make_step_fns",

@@ -27,11 +27,13 @@ import jax.numpy as jnp
 import optax
 
 __all__ = [
+    "FleetSuperstepFns",
     "SeriesSuperstepFns",
     "StepFns",
     "SuperstepFns",
     "gather_window_batch",
     "make_checked_raw_train_step",
+    "make_fleet_superstep_fns",
     "make_optimizer",
     "make_series_superstep_fns",
     "make_step_fns",
@@ -163,6 +165,22 @@ class SeriesSuperstepFns:
     train_superstep: Callable
 
 
+@dataclasses.dataclass(frozen=True)
+class FleetSuperstepFns:
+    """A jitted S-step superstep over one fleet shape class
+    (see :func:`make_fleet_superstep_fns`)."""
+
+    #: (params, opt_state, supports_stack, series, targets, offsets,
+    #: idx_block, mask_block, slot_block, n_real_block) -> (params,
+    #: opt_state, losses); supports_stack is the class's stacked
+    #: (n_members, M, K, N_c, N_c) padded supports, series the class's
+    #: time-concatenated (sum_T, N_c, C) resident series, targets the
+    #: mode's class-absolute int32 target timesteps, slot_block (S,) int32
+    #: member slots (one support gather per step), n_real_block (S,) int32
+    #: real node counts feeding the traced gate pooling
+    train_superstep: Callable
+
+
 def gather_window_batch(series, targets, offsets, idx, horizon: int = 1):
     """Reconstruct a microbatch ``(x, y)`` from the resident raw series.
 
@@ -214,8 +232,8 @@ def _raw_step_bodies(model, optimizer, loss: str):
     if loss not in LOSSES:
         raise ValueError(f"loss must be one of {LOSSES}, got {loss!r}")
 
-    def loss_fn(params, supports, x, y, mask):
-        pred = model.apply(params, supports, x)
+    def loss_fn(params, supports, x, y, mask, n_real=None):
+        pred = model.apply(params, supports, x, n_real)
         err = _elementwise_loss(loss, pred.astype(jnp.float32), y.astype(jnp.float32))
         # y is (B, N, C) single-step or (B, H, N, C) seq2seq
         if mask.ndim == 1:  # (B,): per-sample weights
@@ -231,16 +249,16 @@ def _raw_step_bodies(model, optimizer, loss: str):
         params = model.init(rng, supports, x)
         return params, optimizer.init(params)
 
-    def train_step(params, opt_state, supports, x, y, mask):
+    def train_step(params, opt_state, supports, x, y, mask, n_real=None):
         (loss_val, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, supports, x, y, mask
+            params, supports, x, y, mask, n_real
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss_val
 
-    def eval_step(params, supports, x, y, mask):
-        loss_val, pred = loss_fn(params, supports, x, y, mask)
+    def eval_step(params, supports, x, y, mask, n_real=None):
+        loss_val, pred = loss_fn(params, supports, x, y, mask, n_real)
         return loss_val, pred
 
     return init, train_step, eval_step
@@ -295,13 +313,13 @@ def make_step_fns(
     ck_train = jax.jit(checkify.checkify(train_step, errors=errset), donate_argnums=(0, 1))
     ck_eval = jax.jit(checkify.checkify(eval_step, errors=errset))
 
-    def checked_train(params, opt_state, supports, x, y, mask):
-        err, out = ck_train(params, opt_state, supports, x, y, mask)
+    def checked_train(params, opt_state, supports, x, y, mask, n_real=None):
+        err, out = ck_train(params, opt_state, supports, x, y, mask, n_real)
         checkify.check_error(err)  # device sync; raises at the failing step
         return out
 
-    def checked_eval(params, supports, x, y, mask):
-        err, out = ck_eval(params, supports, x, y, mask)
+    def checked_eval(params, supports, x, y, mask, n_real=None):
+        err, out = ck_eval(params, supports, x, y, mask, n_real)
         checkify.check_error(err)
         return out
 
@@ -470,3 +488,78 @@ def make_series_superstep_fns(
         return out
 
     return SeriesSuperstepFns(train_superstep=checked_superstep)
+
+
+def make_fleet_superstep_fns(
+    model,
+    optimizer: optax.GradientTransformation,
+    loss: str = "mse",
+    horizon: int = 1,
+    checks: str | None = None,
+) -> FleetSuperstepFns:
+    """The window-free superstep of :func:`make_series_superstep_fns`
+    generalized to one fleet *shape class* of cities.
+
+    One compiled program serves every member city of the class: the
+    class's padded per-city supports ride stacked on a leading member
+    axis and each scan step selects its city's stack with a ``jnp.take``
+    over ``slot_block``; the per-city resident series are concatenated
+    along time (targets pre-shifted to class-absolute timesteps, so the
+    window gather never crosses a city boundary); and the traced
+    ``n_real_block`` feeds the gate pooling so cities with fewer real
+    nodes than the class rung pool over real rows only. The support
+    gather and window gather are pure index copies and the scan body is
+    the same shared raw train step, so a class block's results are
+    bit-identical to per-step iteration at the class shapes — which is
+    exactly what the materialized per-city oracle computes
+    (``tests/test_fleet.py``). Padded nodes carry zero supports, a
+    traced-masked gate pool, and zero ``(B, N_c)`` loss-mask columns.
+    """
+    if checks is not None and checks not in CHECK_SETS:
+        raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
+
+    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+
+    def train_superstep(
+        params, opt_state, supports_stack, series, targets, offsets,
+        idx_block, mask_block, slot_block, n_real_block,
+    ):
+        def body(carry, step_inputs):
+            params, opt_state = carry
+            idx, mask, slot, n_real = step_inputs
+            supports = jnp.take(supports_stack, slot, axis=0)
+            x, y = gather_window_batch(series, targets, offsets, idx, horizon)
+            params, opt_state, loss_val = train_step(
+                params, opt_state, supports, x, y, mask, n_real
+            )
+            return (params, opt_state), loss_val
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (idx_block, mask_block, slot_block, n_real_block)
+        )
+        return params, opt_state, losses
+
+    if checks is None:
+        return FleetSuperstepFns(
+            train_superstep=jax.jit(train_superstep, donate_argnums=(0, 1))
+        )
+
+    from jax.experimental import checkify
+
+    ck = jax.jit(
+        checkify.checkify(train_superstep, errors=_error_set(checks)),
+        donate_argnums=(0, 1),
+    )
+
+    def checked_superstep(
+        params, opt_state, supports_stack, series, targets, offsets,
+        idx_block, mask_block, slot_block, n_real_block,
+    ):
+        err, out = ck(
+            params, opt_state, supports_stack, series, targets, offsets,
+            idx_block, mask_block, slot_block, n_real_block,
+        )
+        checkify.check_error(err)
+        return out
+
+    return FleetSuperstepFns(train_superstep=checked_superstep)

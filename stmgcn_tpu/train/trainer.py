@@ -51,10 +51,12 @@ should also see the files themselves.
 
 from __future__ import annotations
 
+import dataclasses
 import errno
 import json
 import os
 import signal
+import sys
 import threading
 import time
 from typing import Optional
@@ -74,7 +76,9 @@ from stmgcn_tpu.train.checkpoint import (
 )
 from stmgcn_tpu.train.metrics import regression_report
 from stmgcn_tpu.train.step import (
+    StepFns,
     gather_window_batch,
+    make_fleet_superstep_fns,
     make_optimizer,
     make_series_superstep_fns,
     make_step_fns,
@@ -106,6 +110,18 @@ class CitySupports:
 
     def map(self, fn) -> "CitySupports":
         return CitySupports(fn(s) for s in self.per_city)
+
+
+@dataclasses.dataclass(frozen=True)
+class _FleetCity:
+    """One fleet city's place in its shape class (trainer-internal)."""
+
+    cls: int  # shape-class index in the plan
+    slot: int  # member slot in the class's stacked supports
+    rung: int  # class node count N_c every member pads to
+    n_real: int  # real node rows (traced gate-pooling divisor)
+    pad: int  # rung - n_real
+    t_offset: int  # city's time offset in the class's concatenated series
 
 
 def _contains_blocksparse(supports) -> bool:
@@ -182,6 +198,9 @@ class Trainer:
         data_placement: str = "auto",
         window_free: Optional[bool] = None,
         steps_per_superstep: int = 1,
+        fleet: Optional[bool] = None,
+        fleet_max_classes: int = 8,
+        fleet_max_pad_waste: float = 0.5,
         async_checkpoint: bool = True,
         checkpoint_every_steps: int = 0,
         divergence_guard: bool = False,
@@ -332,16 +351,18 @@ class Trainer:
                 "data_placement='resident' requires a single-device "
                 "placement; mesh runs stream batches (with prefetch)"
             )
-        # Window-free residency needs the series/targets protocol — the
-        # homogeneous DemandDataset has it, the heterogeneous dataset
-        # (per-city shapes) falls back to materialized windows.
+        # Window-free residency needs the series/targets protocol — both
+        # the homogeneous DemandDataset and the heterogeneous dataset
+        # (per-city series delegation) speak it; custom datasets without
+        # it fall back to materialized windows.
         wf_supported = hasattr(dataset, "series") and hasattr(
             dataset, "mode_targets"
         )
         if window_free and not wf_supported:
             raise ValueError(
-                "window_free=True requires a homogeneous DemandDataset "
-                "(the heterogeneous dataset has no shared series protocol)"
+                "window_free=True requires the series/mode_targets "
+                "protocol (DemandDataset or HeteroCityDataset) — this "
+                "dataset only materializes windows"
             )
         wf_candidate = wf_supported and window_free is not False
         # "auto" sizes against what would actually sit in HBM: the raw
@@ -429,6 +450,85 @@ class Trainer:
             else None
         )
         self._city_fns: dict = {}
+        # Fleet shape classes: heterogeneous cities grouped into a bounded
+        # set of node-count rungs (data/fleet.py) so ONE compiled program
+        # per class covers every member city — the fused window-free
+        # superstep gathers each city's microbatch from the class's
+        # concatenated resident series, selects its padded support stack
+        # by slot, and feeds the traced real-node count to the gate
+        # pooling. Engaged when requested (fleet=True) or automatically
+        # when superstep fusion is asked for (S > 1) on a viable
+        # heterogeneous dataset; fleet=False never engages.
+        self._fleet_plan = None
+        self._fleet_cities: dict = {}
+        self._fleet_series_cache: dict = {}
+        self._fleet_targets_cache: dict = {}
+        self._fleet_supports_cache: dict = {}
+        self._fleet_fns = None
+        self._make_fleet_fns = lambda: make_fleet_superstep_fns(
+            model, self._optimizer, loss, horizon=self._horizon, checks=checks
+        )
+        if fleet_max_classes < 1:
+            raise ValueError(f"fleet_max_classes must be >= 1, got {fleet_max_classes}")
+        if not 0.0 <= fleet_max_pad_waste < 1.0:
+            raise ValueError(
+                f"fleet_max_pad_waste must be in [0, 1), got {fleet_max_pad_waste}"
+            )
+        self.fleet = fleet
+        self.fleet_max_classes = fleet_max_classes
+        self.fleet_max_pad_waste = fleet_max_pad_waste
+        want_fleet = fleet is True or (fleet is None and steps_per_superstep > 1)
+        fleet_blocker = None
+        if not hetero:
+            fleet_blocker = (
+                "the dataset is homogeneous (one shared graph fuses already)"
+            )
+        elif not self._resident:
+            fleet_blocker = (
+                "data placement is not resident (stream/mesh upload per batch)"
+            )
+        elif not (
+            isinstance(self.supports, CitySupports)
+            and all(getattr(s, "ndim", None) == 4 for s in self.supports.per_city)
+        ):
+            fleet_blocker = "per-city supports are not dense (M, K, N, N) stacks"
+        if fleet is True and fleet_blocker is not None:
+            raise ValueError(f"fleet=True cannot engage: {fleet_blocker}")
+        if want_fleet and fleet_blocker is None:
+            from stmgcn_tpu.data.fleet import plan_shape_classes
+
+            # the planner sees the base-padded sizes (a mesh-divisibility
+            # pad must survive inside the rung); the trainer's pads then
+            # absorb the base pad: total pad = rung - real nodes
+            self._fleet_plan = plan_shape_classes(
+                [n + p for n, p in zip(dataset.city_n_nodes, pads)],
+                max_classes=fleet_max_classes,
+                max_pad_waste=fleet_max_pad_waste,
+            )
+            new_pads = list(self._node_pads)
+            new_sup = list(self.supports.per_city)
+            for ci, cls in enumerate(self._fleet_plan.classes):
+                t_off = 0
+                for slot, c in enumerate(cls.cities):
+                    n = dataset.city_n_nodes[c]
+                    new_pads[c] = cls.n_nodes - n
+                    grow = cls.n_nodes - new_sup[c].shape[-1]
+                    if grow:  # zero node rows/cols up to the rung
+                        new_sup[c] = jnp.pad(
+                            new_sup[c], [(0, 0), (0, 0), (0, grow), (0, grow)]
+                        )
+                    self._fleet_cities[c] = _FleetCity(
+                        cls=ci, slot=slot, rung=cls.n_nodes, n_real=n,
+                        pad=cls.n_nodes - n, t_offset=t_off,
+                    )
+                    t_off += dataset.series(c).shape[0]
+            self._node_pads = tuple(new_pads)
+            self.node_pad = (
+                self._node_pads[0]
+                if len(set(self._node_pads)) == 1
+                else self._node_pads
+            )
+            self.supports = CitySupports(new_sup)
         # window-free: an index-only example batch keeps even init off the
         # materialized windows — no host window array is ever built
         example = next(dataset.batches(
@@ -454,6 +554,71 @@ class Trainer:
         self.is_lead = jax.process_index() == 0
         if self.is_lead:
             os.makedirs(out_dir, exist_ok=True)
+
+        # Surface the silent slow path: when superstep fusion was asked
+        # for (S > 1) but training (fully or partly) runs the per-step
+        # loop, say so once — one structured line naming the reason, and
+        # the machine-readable `train_path` / `fallback_reason` for tests.
+        #: which path training epochs take: "superstep" /
+        #: "series_superstep" (homogeneous fused), "fleet_superstep"
+        #: (per-class fused), or "per_step" (the materialized loop)
+        self.train_path = "per_step"
+        #: why (part of) training runs the per-step loop; None when the
+        #: fused path fully covers the run or was never requested (S == 1)
+        self.fallback_reason = None
+        if steps_per_superstep > 1:
+            if self._superstep_ready():
+                self.train_path = (
+                    "series_superstep" if self._window_free else "superstep"
+                )
+            elif self._fleet_superstep_ready():
+                self.train_path = "fleet_superstep"
+                if self._fleet_plan.unassigned:
+                    self.fallback_reason = (
+                        "no-class-fit: cities "
+                        f"{sorted(self._fleet_plan.unassigned)} fit no shape "
+                        f"class (fleet_max_classes={fleet_max_classes}, "
+                        f"fleet_max_pad_waste={fleet_max_pad_waste}) and run "
+                        "the per-step loop"
+                    )
+            elif not self._resident:
+                self.fallback_reason = (
+                    "stream: data placement is not resident, batches upload "
+                    "per step"
+                )
+            elif hetero and fleet is False:
+                self.fallback_reason = (
+                    "hetero: heterogeneous cities with fleet=False take the "
+                    "materialized per-city loop"
+                )
+            elif hetero and fleet_blocker is not None:
+                self.fallback_reason = f"hetero: {fleet_blocker}"
+            elif hetero and not self._window_free:
+                self.fallback_reason = (
+                    "hetero: window_free=False keeps the materialized "
+                    "per-city loop (the fleet parity oracle)"
+                )
+            elif hetero:
+                self.fallback_reason = "hetero: no city fits any shape class"
+            elif isinstance(self.supports, CitySupports):
+                self.fallback_reason = (
+                    "per-city support stacks (CitySupports) on a homogeneous "
+                    "dataset gather per step"
+                )
+            elif self._city_n_real is not None:
+                self.fallback_reason = (
+                    "per-city node padding clones the model per city"
+                )
+            else:
+                self.fallback_reason = "superstep prerequisites not met"
+        if self.fallback_reason is not None and self.is_lead:
+            print(
+                f"[slow-path] {self.fallback_reason} "
+                f"(steps_per_superstep={steps_per_superstep}, "
+                f"train_path={self.train_path})",
+                file=sys.stderr,
+                flush=True,
+            )
 
     # -- paths ----------------------------------------------------------
     @property
@@ -614,6 +779,13 @@ class Trainer:
         """Padded node rows appended to this city's arrays/supports."""
         return self._node_pads[city]
 
+    def _city_nodes(self, city: int) -> int:
+        """A city's real region count (per-city for heterogeneous data)."""
+        ds = self.dataset
+        if getattr(ds, "heterogeneous", False):
+            return ds.city_n_nodes[city]
+        return ds.n_nodes
+
     def _train_steps_per_epoch(self) -> int:
         """Optimizer steps per training epoch (sizes LR schedules).
 
@@ -633,10 +805,29 @@ class Trainer:
     def _fns(self, city: int):
         """The step functions for a city's batches.
 
-        Cities whose node axis carries padding get steps closed over a
-        model clone with that city's ``n_real_nodes`` (the gate pooling
-        mean must divide by real nodes, not padded N).
+        Fleet cities pass their real-node count as a *traced* argument
+        (the same arithmetic the fused per-class program scans over, so
+        per-step fallback/eval stay bit-identical to it — and one
+        compiled step serves every city of a shape class). Non-fleet
+        cities whose node axis carries padding get steps closed over a
+        model clone with that city's static ``n_real_nodes`` (the gate
+        pooling mean must divide by real nodes, not padded N).
         """
+        info = self._fleet_cities.get(city)
+        if info is not None:
+            if city not in self._city_fns:
+                base = self.step_fns
+                nr = jnp.int32(info.n_real)
+                self._city_fns[city] = StepFns(
+                    init=base.init,
+                    train_step=lambda p, o, s, x, y, m, _b=base, _nr=nr: (
+                        _b.train_step(p, o, s, x, y, m, _nr)
+                    ),
+                    eval_step=lambda p, s, x, y, m, _b=base, _nr=nr: (
+                        _b.eval_step(p, s, x, y, m, _nr)
+                    ),
+                )
+            return self._city_fns[city]
         if self._city_n_real is None or self._city_n_real[city] is None:
             return self.step_fns
         if city not in self._city_fns:
@@ -692,6 +883,11 @@ class Trainer:
     def _place_batch(self, batch, mode: str):
         sample_mask = (np.arange(len(batch)) < batch.n_real).astype(np.float32)
         pad = self._pad_for(batch.city)
+        # fleet cities ALWAYS carry node-crossed masks, even at pad == 0:
+        # the fused per-class program scans one mask shape for every
+        # member, and per-step fallback/eval must feed the step body the
+        # identical mask broadcast to stay bit-exact with it
+        force = batch.city in self._fleet_cities
         if self._resident and batch.indices is not None:
             idx = jnp.asarray(batch.indices)  # a few hundred bytes, not the data
             if self._window_free:
@@ -704,35 +900,52 @@ class Trainer:
                     idx,
                     self._horizon,
                 )
-                mask = self._mask(sample_mask, self.dataset.n_nodes + pad, pad)
+                mask = self._mask(
+                    sample_mask, self._city_nodes(batch.city) + pad, pad,
+                    force_nodes=force,
+                )
                 return x, y, mask
             x_all, y_all = self._resident_arrays(mode, batch.city)
-            mask = self._mask(sample_mask, y_all.shape[y_all.ndim - 2], pad)
+            mask = self._mask(
+                sample_mask, y_all.shape[y_all.ndim - 2], pad, force_nodes=force
+            )
             return jnp.take(x_all, idx, axis=0), jnp.take(y_all, idx, axis=0), mask
-        mask = self._mask(sample_mask, batch.y.shape[batch.y.ndim - 2] + pad, pad)
+        mask = self._mask(
+            sample_mask, batch.y.shape[batch.y.ndim - 2] + pad, pad,
+            force_nodes=force,
+        )
         bx, by = batch.x, batch.y
         if pad:
             bx = self._pad_nodes(bx, 2, pad)  # (B,T,N,C)
             by = self._pad_nodes(by, by.ndim - 2, pad)  # (B,[H,]N,C)
         return self.placement.put(bx, "x"), self.placement.put(by, "y"), mask
 
-    def _mask_np(self, sample_mask, n_padded_nodes: int, pad: int) -> np.ndarray:
+    def _mask_np(
+        self, sample_mask, n_padded_nodes: int, pad: int,
+        force_nodes: bool = False,
+    ) -> np.ndarray:
         """Loss mask: samples, crossed with real-node rows when node-padded.
 
+        ``force_nodes`` emits the crossed ``(B, N)`` form even at
+        ``pad == 0`` (fleet cities: one mask shape per shape class).
         Host-side numpy — the superstep path stacks S of these into one
         block before placing it; the per-step path places each via
         :meth:`_mask`.
         """
-        if not pad:
+        if not pad and not force_nodes:
             return sample_mask
         node_mask = (
             np.arange(n_padded_nodes) < n_padded_nodes - pad
         ).astype(np.float32)
         return sample_mask[:, None] * node_mask[None, :]
 
-    def _mask(self, sample_mask, n_padded_nodes: int, pad: int):
+    def _mask(
+        self, sample_mask, n_padded_nodes: int, pad: int,
+        force_nodes: bool = False,
+    ):
         return self.placement.put(
-            self._mask_np(sample_mask, n_padded_nodes, pad), "mask"
+            self._mask_np(sample_mask, n_padded_nodes, pad, force_nodes),
+            "mask",
         )
 
     def _resident_arrays(self, mode: str, city: int):
@@ -764,6 +977,11 @@ class Trainer:
         path's ~``seq_len``x memory saving lives. Node padding is applied
         to the series once; gathered windows come out pre-padded.
         """
+        info = self._fleet_cities.get(city)
+        if info is not None:
+            # one resident copy per shape class: the city's rows live in
+            # the class's time-concatenated series at its time offset
+            return self._fleet_series(info.cls)
         if city not in self._resident_series_cache:
             s = (
                 self.dataset.series_stack()
@@ -780,9 +998,18 @@ class Trainer:
         """Device int32 target-timestep vector for a mode's samples."""
         key = (mode, city)
         if key not in self._resident_targets_cache:
-            t = self.dataset.mode_targets(
-                mode, None if self.dataset.shared_graphs else city
-            )
+            info = self._fleet_cities.get(city)
+            if info is not None:
+                # offsets into the class's concatenated series — the
+                # gathered rows are bitwise the per-city series rows
+                t = (
+                    np.asarray(self.dataset.mode_targets(mode, city))
+                    + info.t_offset
+                ).astype(np.int32)
+            else:
+                t = self.dataset.mode_targets(
+                    mode, None if self.dataset.shared_graphs else city
+                )
             self._resident_targets_cache[key] = self.placement.put(t, "x")
         return self._resident_targets_cache[key]
 
@@ -815,6 +1042,71 @@ class Trainer:
             and not isinstance(self.supports, CitySupports)
             and self._city_n_real is None
         )
+
+    def _fleet_superstep_ready(self) -> bool:
+        """Whether training epochs can take the per-class fleet superstep.
+
+        Requires an engaged fleet plan (heterogeneous, resident, dense
+        per-city supports — established in ``__init__``) plus the
+        window-free gather the fused program is built on. Cities the plan
+        left unassigned run per-step; ``window_free=False`` fleet
+        trainers run the materialized per-city loop (the parity oracle).
+        """
+        return (
+            self.steps_per_superstep > 1
+            and bool(self._fleet_cities)
+            and self._window_free
+        )
+
+    # -- fleet residency: one device copy per shape class ----------------
+    def _fleet_series(self, cls_id: int):
+        """The class's resident series: member cities node-padded to the
+        rung and concatenated along time, uploaded once per run."""
+        if cls_id not in self._fleet_series_cache:
+            cls = self._fleet_plan.classes[cls_id]
+            parts = []
+            for c in cls.cities:
+                s = self.dataset.series(c)
+                pad = cls.n_nodes - s.shape[1]
+                if pad:
+                    s = self._pad_nodes(s, 1, pad)
+                parts.append(s)
+            self._fleet_series_cache[cls_id] = self.placement.put(
+                np.concatenate(parts, axis=0), "x"
+            )
+        return self._fleet_series_cache[cls_id]
+
+    def _fleet_targets(self, mode: str, cls_id: int):
+        """``(targets, bases)``: the class's concatenated device target
+        vector for a mode (per-city targets shifted by each city's time
+        offset) and each member's base index into it."""
+        key = (mode, cls_id)
+        if key not in self._fleet_targets_cache:
+            cls = self._fleet_plan.classes[cls_id]
+            parts, bases, base = [], {}, 0
+            for c in cls.cities:
+                t = (
+                    np.asarray(self.dataset.mode_targets(mode, c))
+                    + self._fleet_cities[c].t_offset
+                ).astype(np.int32)
+                bases[c] = base
+                base += t.shape[0]
+                parts.append(t)
+            self._fleet_targets_cache[key] = (
+                self.placement.put(np.concatenate(parts), "x"),
+                bases,
+            )
+        return self._fleet_targets_cache[key]
+
+    def _fleet_supports(self, cls_id: int):
+        """The class's ``(n_members, M, K, rung, rung)`` support stack
+        (member supports are already rung-padded in ``__init__``)."""
+        if cls_id not in self._fleet_supports_cache:
+            cls = self._fleet_plan.classes[cls_id]
+            self._fleet_supports_cache[cls_id] = jnp.stack(
+                [self.supports.for_city(c) for c in cls.cities]
+            )
+        return self._fleet_supports_cache[cls_id]
 
     def _run_epoch(self, mode: str, train: bool) -> float:
         """Sample-weighted mean loss over a mode (``Model_Trainer.py:43-44``).
@@ -855,6 +1147,11 @@ class Trainer:
         # superstep by the PR 2 parity contract, just unfused
         if self._superstep_ready() and skip % self.steps_per_superstep == 0:
             self._run_train_epoch_superstep(mode, skip)
+        elif (
+            self._fleet_superstep_ready()
+            and skip % self.steps_per_superstep == 0
+        ):
+            self._run_train_epoch_fleet(mode, skip)
         else:
             self._run_train_epoch_steps(mode, skip)
         deferred, self._deferred = self._deferred, []
@@ -968,6 +1265,7 @@ class Trainer:
         self._optimizer = self._optimizer_factory(scale)
         self.step_fns = self._make_fns(self.model)
         self._superstep_fns = None
+        self._fleet_fns = None
         self._city_fns.clear()
 
     def _pack_blocks(self, batches, mode: str):
@@ -1108,6 +1406,147 @@ class Trainer:
             x, y, mask = self._place_batch(batch, mode)
             self._train_one(batch, x, y, mask)
             self._after_train_batch()
+
+    def _pack_fleet_blocks(self, run, info, base: int):
+        """Stack one fleet city's index-only batches into ``(idx_block,
+        mask_block, n_reals)`` triples of exactly S steps; the tail short
+        of a full S runs per-step (same rule as :meth:`_pack_blocks`).
+
+        Indices shift by the city's ``base`` into the class's concatenated
+        target vector; masks are node-crossed at the rung width always
+        (``force_nodes`` — the scanned program's one mask shape).
+        """
+        S = self.steps_per_superstep
+        blocks = []
+        for i in range(len(run) // S):
+            chunk = run[i * S:(i + 1) * S]
+            idx_block = np.stack(
+                [np.asarray(b.indices, np.int64) + base for b in chunk]
+            ).astype(np.int32)
+            mask_block = np.stack([
+                self._mask_np(
+                    (np.arange(len(b)) < b.n_real).astype(np.float32),
+                    info.rung, info.pad, force_nodes=True,
+                )
+                for b in chunk
+            ])
+            blocks.append((idx_block, mask_block, [b.n_real for b in chunk]))
+        return blocks, run[(len(run) // S) * S:]
+
+    def _run_train_epoch_fleet(self, mode: str, skip: int) -> None:
+        """Training epoch as per-class fused S-step dispatches.
+
+        The heterogeneous epoch arrives city-sequential; consecutive
+        batches of one fleet city pack into ``(S, B)`` blocks dispatched
+        through the class's ONE compiled program (``train/step.py``
+        ``make_fleet_superstep_fns``): each scanned step selects the
+        city's padded support stack by slot, gathers its microbatch from
+        the class's concatenated resident series, and divides the gate
+        pooling by the traced real-node count. Cities the plan left
+        unassigned — and every run's tail short of a full S — take the
+        per-step loop, bit-identical by the parity contract. Double
+        buffering, fault handling, and the divergence guard mirror
+        :meth:`_run_train_epoch_superstep` at block granularity.
+        """
+        if self._fleet_fns is None:
+            self._fleet_fns = self._make_fleet_fns()
+        S = self.steps_per_superstep
+        batches = list(self.dataset.batches(
+            mode, self.batch_size, shuffle=self.shuffle, seed=self.seed,
+            epoch=self.epoch, pad_last=True, with_arrays=False,
+        ))
+        if skip > len(batches):
+            raise ValueError(
+                f"resume cursor {skip} exceeds the epoch's {len(batches)} "
+                "batches — checkpoint from a different data configuration?"
+            )
+        runs: list = []  # consecutive same-city runs, epoch order kept
+        for b in batches[skip:]:
+            if runs and runs[-1][0] == b.city:
+                runs[-1][1].append(b)
+            else:
+                runs.append((b.city, [b]))
+        plan, guard = self.fault_plan, self._guard
+
+        def per_step(batch):
+            x, y, mask = self._place_batch(batch, mode)
+            self._train_one(batch, x, y, mask)
+            self._after_train_batch()
+
+        def place(block):
+            idx_np, mask_np, n_reals = block
+            return jnp.asarray(idx_np), jnp.asarray(mask_np), n_reals
+
+        for city, run in runs:
+            info = self._fleet_cities.get(city)
+            if info is None:  # no shape class fits: the per-step loop
+                for batch in run:
+                    per_step(batch)
+                continue
+            series = self._fleet_series(info.cls)
+            targets, bases = self._fleet_targets(mode, info.cls)
+            offsets = self._offsets_device()
+            sup_stack = self._fleet_supports(info.cls)
+            blocks, remainder = self._pack_fleet_blocks(
+                run, info, bases[city]
+            )
+            slot_d = jnp.full((S,), info.slot, jnp.int32)
+            nr_d = jnp.full((S,), info.n_real, jnp.int32)
+
+            def per_step_block(i, run=run):
+                for batch in run[i * S:(i + 1) * S]:
+                    per_step(batch)
+
+            placed = place(blocks[0]) if blocks else None
+            for i in range(len(blocks)):
+                start = self._batch_in_epoch
+                plan.before_step(self.epoch, start, start + S)
+                if plan.active and plan.any_drop(self.epoch, start, start + S):
+                    placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+                    per_step_block(i)
+                    continue
+                idx_d, mask_d, n_reals = placed
+                if plan.active:
+                    for s in range(S):
+                        poison = plan.poison_value(self.epoch, start + s)
+                        if poison is not None:
+                            mask_d = mask_d.at[
+                                (s,) + (0,) * (mask_d.ndim - 1)
+                            ].set(poison)
+                if guard is not None:
+                    snapshot = (
+                        jax.tree.map(jnp.copy, self.params),
+                        jax.tree.map(jnp.copy, self.opt_state),
+                    )
+                self.params, self.opt_state, loss_vec = (
+                    self._fleet_fns.train_superstep(
+                        self.params, self.opt_state, sup_stack, series,
+                        targets, offsets, idx_d, mask_d, slot_d, nr_d,
+                    )
+                )
+                # block i is dispatched; upload i+1 under its compute
+                placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+                if guard is not None and not np.isfinite(
+                    np.asarray(loss_vec)
+                ).all():
+                    self.params, self.opt_state = snapshot
+                    self._log(
+                        f"divergence guard: non-finite loss in fleet "
+                        f"superstep block at epoch {self.epoch}, steps "
+                        f"{start}..{start + S - 1} — rolled back, "
+                        "replaying per-step"
+                    )
+                    per_step_block(i)
+                    continue
+                if guard is not None:
+                    guard.ok()
+                self._batch_in_epoch += S
+                self.global_step += S
+                self._epoch_losses.append(loss_vec)  # (S,) — stays on device
+                self._epoch_counts.extend(n_reals)
+                self._after_train_batch()
+            for batch in remainder:
+                per_step(batch)
 
     # -- public API -----------------------------------------------------
     def train(self) -> dict:

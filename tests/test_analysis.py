@@ -835,3 +835,124 @@ class TestResidentMemoryRule:
         cfg.data.n_timesteps = 500000  # far past the budget
         assert cfg.train.data_placement == "auto"
         assert check_resident_memory([("big", cfg)]) == []
+
+
+class TestFleetShapeClassRule:
+    """Pass 2g: the fleet-shape-class planner contract (pure config math
+    — the same plan_shape_classes the trainer runs at construction,
+    checked against knob validity, city coverage, and the per-core
+    resident budget at lint time)."""
+
+    @staticmethod
+    def _engaged_multicity():
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("multicity")  # cities N=144 and N=100
+        cfg.train.steps_per_superstep = 4
+        return cfg
+
+    def test_rule_registered_as_error(self):
+        assert RULES["fleet-shape-class"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_fleet_shape_classes
+
+        assert check_fleet_shape_classes() == []
+
+    def test_disengaged_config_skipped(self):
+        """fleet=None with S=1 never takes the fleet path — even absurd
+        knobs must not fire."""
+        from stmgcn_tpu.analysis import check_fleet_shape_classes
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("multicity")
+        assert cfg.train.steps_per_superstep == 1
+        cfg.train.fleet_max_classes = 0
+        assert check_fleet_shape_classes([("off", cfg)]) == []
+
+    def test_invalid_knobs_fire(self):
+        from stmgcn_tpu.analysis import check_fleet_shape_classes
+
+        cfg = self._engaged_multicity()
+        cfg.train.fleet_max_classes = 0
+        f = check_fleet_shape_classes([("bad", cfg)])
+        assert [x.rule for x in f] == ["fleet-shape-class"]
+        assert "fleet_max_classes" in f[0].message
+        assert f[0].path == "<contract:fleet:bad>"
+
+        cfg = self._engaged_multicity()
+        cfg.train.fleet_max_pad_waste = 1.0
+        f = check_fleet_shape_classes([("bad", cfg)])
+        assert any("fleet_max_pad_waste" in x.message for x in f)
+
+    def test_fleet_on_homogeneous_fires(self):
+        from stmgcn_tpu.analysis import check_fleet_shape_classes
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("smoke")
+        cfg.train.fleet = True
+        f = check_fleet_shape_classes([("homog", cfg)])
+        assert any("homogeneous" in x.message for x in f)
+
+    def test_fleet_on_streamed_data_fires(self):
+        from stmgcn_tpu.analysis import check_fleet_shape_classes
+
+        cfg = self._engaged_multicity()
+        cfg.train.fleet = True
+        cfg.train.data_placement = "stream"
+        f = check_fleet_shape_classes([("stream", cfg)])
+        assert any("stream" in x.message for x in f)
+
+    def test_uncovered_city_boundary(self):
+        """N=100 in the N=144 rung pads 44/144 of its nodes. The planner
+        assigns at waste == threshold exactly and drops the city one
+        epsilon below — the check must know that boundary."""
+        from stmgcn_tpu.analysis import check_fleet_shape_classes
+
+        cfg = self._engaged_multicity()
+        cfg.train.fleet_max_classes = 1
+        cfg.train.fleet_max_pad_waste = 44 / 144
+        assert check_fleet_shape_classes([("fit", cfg)]) == []
+
+        cfg.train.fleet_max_pad_waste = 44 / 144 - 1e-9
+        f = check_fleet_shape_classes([("tight", cfg)])
+        assert len(f) == 1 and "fit no shape class" in f[0].message
+        assert "[1]" in f[0].message  # the dropped city is named
+
+        # a second class rescues the small city
+        cfg.train.fleet_max_classes = 2
+        assert check_fleet_shape_classes([("two", cfg)]) == []
+
+    def test_class_footprint_budget_boundary(self):
+        """The per-class resident estimate vs the budget, exactly at the
+        byte boundary (strictly-greater fires, equal fits)."""
+        from stmgcn_tpu.analysis import check_fleet_shape_classes
+        from stmgcn_tpu.analysis.fleet_check import estimate_fleet_plan
+
+        cfg = self._engaged_multicity()
+        plan, class_bytes = estimate_fleet_plan(cfg)
+        assert [c.n_nodes for c in plan.classes] == [144]
+        assert plan.unassigned == ()
+        (nbytes,) = class_bytes
+
+        assert check_fleet_shape_classes(
+            [("fit", cfg)], budget_bytes=nbytes) == []
+        f = check_fleet_shape_classes([("oom", cfg)], budget_bytes=nbytes - 1)
+        assert len(f) == 1 and "resident bytes" in f[0].message
+        assert "N=144" in f[0].message
+
+    def test_estimate_matches_trainer_stack_math(self):
+        """The support-stack term is members x M x K x rung^2 x 4 — pin
+        the multicity estimate so the arithmetic cannot drift silently."""
+        from stmgcn_tpu.analysis.fleet_check import estimate_fleet_plan
+        from stmgcn_tpu.data.windowing import WindowSpec
+
+        cfg = self._engaged_multicity()
+        plan, (nbytes,) = estimate_fleet_plan(cfg)
+        d, m = cfg.data, cfg.model
+        spec = WindowSpec(d.serial_len, d.daily_len, d.weekly_len,
+                          d.day_timesteps, horizon=d.horizon)
+        series = sum(t * 144 * 4 for t in d.city_timesteps)
+        targets = sum(4 * spec.n_samples(t) for t in d.city_timesteps)
+        stack = 2 * m.m_graphs * m.n_supports * 144 * 144 * 4
+        assert nbytes == series + targets + stack

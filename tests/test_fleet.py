@@ -1,0 +1,421 @@
+"""Fleet shape-class fast path: planner, trainer parity, serving parity.
+
+The fleet path groups heterogeneous cities into node-count rungs
+(``data/fleet.py``) so ONE fused window-free superstep per class covers
+every member city in training, and one engine with (city -> class)
+routing serves the whole fleet from a single checkpoint
+(``serving/fleet.py``). Because padding is provably inert — zero support
+rows/cols, gate pooling over a traced real-node count, ``(B, N)`` loss
+masks — parity against the materialized per-city oracle is exact
+equality, not allclose: per-batch losses, histories, params, opt-state,
+and served predictions must match bit for bit across >= 2 classes,
+shuffle on/off, padded member cities, a mid-epoch SIGTERM resume, and
+cross-city coalesced serving dispatches.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import ServingConfig, preset
+from stmgcn_tpu.data import (
+    HeteroCityDataset,
+    MinMaxNormalizer,
+    WindowSpec,
+    synthetic_dataset,
+)
+from stmgcn_tpu.data.fleet import FleetPlan, ShapeClass, plan_shape_classes
+from stmgcn_tpu.experiment import build_model
+from stmgcn_tpu.inference import Forecaster
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.resilience import FaultPlan, FaultSpec, Preempted
+from stmgcn_tpu.serving import FleetServingEngine
+from stmgcn_tpu.train import CitySupports, Trainer
+
+BATCH = 8
+#: three cities, two shape classes at the default waste budget: N=9 and
+#: N=8 share the 9-rung (city 1 carries one padded node row), N=4 is too
+#: small for it (waste 5/9 > 0.5) and opens its own rung
+CITY_DIMS = ((3, 3), (2, 4), (2, 2))
+
+
+def city_datas():
+    return [
+        synthetic_dataset(rows=r, cols=c, n_timesteps=24 * 7 * 2 + 12 * i,
+                          seed=i + 1)
+        for i, (r, c) in enumerate(CITY_DIMS)
+    ]
+
+
+def build_fleet(out_dir, *, superstep=1, window_free=None, fleet=None,
+                shuffle=False, epochs=2, **kw):
+    datas = city_datas()
+    dataset = HeteroCityDataset(datas, WindowSpec(3, 1, 1, 24))
+    sup = CitySupports(
+        SupportConfig("chebyshev", 2).build_all(d.adjs.values())
+        for d in datas
+    )
+    model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                   horizon=1, lstm_hidden_dim=8, lstm_num_layers=1,
+                   gcn_hidden_dim=8)
+    return Trainer(model, dataset, sup, n_epochs=epochs, batch_size=BATCH,
+                   shuffle=shuffle, steps_per_superstep=superstep,
+                   window_free=window_free, fleet=fleet,
+                   out_dir=str(out_dir), verbose=False, **kw)
+
+
+def same(a, b):
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+class TestPlanner:
+    """plan_shape_classes unit contracts: greedy rung opening, the
+    covering rule's waste boundary, and knob validation."""
+
+    def test_two_class_plan(self):
+        plan = plan_shape_classes([9, 8, 4])
+        assert [(c.n_nodes, c.cities) for c in plan.classes] == [
+            (4, (2,)), (9, (0, 1))]
+        assert plan.unassigned == ()
+        assert plan.class_of == {2: 0, 0: 1, 1: 1}
+        assert plan.slot_of == {2: 0, 0: 0, 1: 1}
+        assert plan.pad_for(1) == 1 and plan.pad_for(0) == 0
+
+    def test_first_rung_covers_the_largest_city(self):
+        plan = plan_shape_classes([10, 9], max_classes=1, max_pad_waste=0.0)
+        assert [(c.n_nodes, c.cities) for c in plan.classes] == [(10, (0,))]
+        assert plan.unassigned == (1,)
+        assert plan.pad_for(1) is None
+
+    def test_waste_boundary_exact(self):
+        """Membership rule is rung - n > waste * rung: equality joins,
+        one epsilon below drops to unassigned."""
+        at = plan_shape_classes([144, 100], max_classes=1,
+                                max_pad_waste=44 / 144)
+        assert at.classes[0].cities == (0, 1) and at.unassigned == ()
+        below = plan_shape_classes([144, 100], max_classes=1,
+                                   max_pad_waste=44 / 144 - 1e-9)
+        assert below.classes[0].cities == (0,) and below.unassigned == (1,)
+
+    def test_node_multiple_rounds_rungs_up(self):
+        plan = plan_shape_classes([10], node_multiple=8)
+        assert plan.classes[0].n_nodes == 16
+        assert plan.classes[0].pad_for(0) == 6
+
+    def test_waste_properties(self):
+        cls = ShapeClass(n_nodes=10, cities=(0, 1), city_n_nodes=(10, 8),
+                         nnz=100, city_nnz=(100, 64))
+        assert cls.node_waste == pytest.approx(0.2)
+        assert cls.nnz_waste == pytest.approx(0.36)
+        plan = FleetPlan(classes=(cls,), unassigned=())
+        assert plan.node_waste == pytest.approx(0.2)
+
+    @pytest.mark.parametrize("kwargs,match", [
+        (dict(max_classes=0), "max_classes"),
+        (dict(max_pad_waste=1.0), "max_pad_waste"),
+        (dict(max_pad_waste=-0.1), "max_pad_waste"),
+    ])
+    def test_knob_validation(self, kwargs, match):
+        with pytest.raises(ValueError, match=match):
+            plan_shape_classes([4, 9], **kwargs)
+
+    def test_bad_sizes_and_ragged_nnz(self):
+        with pytest.raises(ValueError, match="positive"):
+            plan_shape_classes([4, 0])
+        with pytest.raises(ValueError, match="align"):
+            plan_shape_classes([4, 9], city_nnz=[16])
+
+
+class TestFleetTrainerParity:
+    """The fleet fused path vs its two oracles, bit for bit: the
+    materialized per-city loop at the same class shapes (fleet=True,
+    S=1, window_free=False) and the per-step window-free run."""
+
+    @pytest.mark.parametrize("shuffle", [False, True])
+    def test_bit_identical_to_oracles(self, tmp_path, shuffle):
+        fast = build_fleet(tmp_path / "fast", superstep=3, shuffle=shuffle)
+        assert fast.train_path == "fleet_superstep"
+        assert fast.fallback_reason is None
+        assert [(c.n_nodes, c.cities) for c in fast._fleet_plan.classes] == [
+            (4, (2,)), (9, (0, 1))]
+        assert fast._node_pads == (0, 1, 0)  # padded member city mid-fleet
+        hist_fast = fast.train()
+
+        oracle = build_fleet(tmp_path / "mat", superstep=1, fleet=True,
+                             window_free=False, shuffle=shuffle)
+        assert oracle.train_path == "per_step" and not oracle._window_free
+        assert oracle._node_pads == fast._node_pads
+        hist_mat = oracle.train()
+
+        wf1 = build_fleet(tmp_path / "wf1", superstep=1, fleet=True,
+                          window_free=True, shuffle=shuffle)
+        hist_wf = wf1.train()
+
+        same(hist_fast, hist_mat)
+        same(hist_fast, hist_wf)
+        same(fast.params, oracle.params)
+        same(jax.tree.leaves(fast.opt_state), jax.tree.leaves(oracle.opt_state))
+        same(fast.params, wf1.params)
+
+    def test_unassigned_city_falls_back_per_step_bit_exact(self, tmp_path):
+        """A 1-class budget with a tight waste threshold leaves the small
+        cities unassigned: they run the per-step loop while city 0 stays
+        fused — and the mixed run still matches the oracle bitwise."""
+        knobs = dict(fleet_max_classes=1, fleet_max_pad_waste=0.05)
+        fast = build_fleet(tmp_path / "fast", superstep=3, **knobs)
+        assert fast.train_path == "fleet_superstep"
+        assert "no-class-fit" in fast.fallback_reason
+        assert "[1, 2]" in fast.fallback_reason
+        assert sorted(fast._fleet_plan.unassigned) == [1, 2]
+        assert sorted(fast._fleet_cities) == [0]
+        hist_fast = fast.train()
+
+        oracle = build_fleet(tmp_path / "mat", superstep=1, fleet=True,
+                             window_free=False, **knobs)
+        hist_mat = oracle.train()
+        same(hist_fast, hist_mat)
+        same(fast.params, oracle.params)
+
+
+class TestFleetPaths:
+    """train_path / fallback_reason surfacing and fleet=True blockers."""
+
+    def test_fleet_false_keeps_materialized_loop(self, tmp_path):
+        t = build_fleet(tmp_path, superstep=3, fleet=False)
+        assert t.train_path == "per_step"
+        assert "fleet=False" in t.fallback_reason
+
+    def test_hetero_window_free_false_is_the_oracle_path(self, tmp_path):
+        t = build_fleet(tmp_path, superstep=3, window_free=False)
+        assert t.train_path == "per_step"
+        assert "window_free=False" in t.fallback_reason
+
+    def test_fleet_true_on_homogeneous_raises(self, tmp_path):
+        from stmgcn_tpu.data import DemandDataset
+
+        data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2, seed=1)
+        dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+        sup = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+        model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                       horizon=1, lstm_hidden_dim=8, lstm_num_layers=1,
+                       gcn_hidden_dim=8)
+        with pytest.raises(ValueError, match="homogeneous"):
+            Trainer(model, dataset, sup, n_epochs=1, batch_size=BATCH,
+                    fleet=True, out_dir=str(tmp_path), verbose=False)
+
+    def test_fleet_true_on_streamed_data_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="resident"):
+            build_fleet(tmp_path, superstep=3, fleet=True,
+                        data_placement="stream")
+
+    def test_trainer_validates_knobs(self, tmp_path):
+        with pytest.raises(ValueError, match="fleet_max_classes"):
+            build_fleet(tmp_path, fleet_max_classes=0)
+        with pytest.raises(ValueError, match="fleet_max_pad_waste"):
+            build_fleet(tmp_path, fleet_max_pad_waste=1.0)
+
+
+class TestFleetResume:
+    """Mid-epoch SIGTERM on the fleet path: resume must end bit-identical
+    to the uninterrupted fleet run (same drill as
+    test_window_free.TestWindowFreeResume, on the per-class path)."""
+
+    def test_sigterm_resume_bit_exact(self, tmp_path):
+        ref = build_fleet(tmp_path / "ref", superstep=3)
+        ref_hist = ref.train()
+
+        plan = FaultPlan(FaultSpec("sigterm", epoch=2, step=4))
+        faulted = build_fleet(tmp_path / "run", superstep=3, fault_plan=plan)
+        assert faulted.train_path == "fleet_superstep"
+        with pytest.raises(Preempted, match="--resume auto"):
+            faulted.train()
+
+        resumed = build_fleet(tmp_path / "run", superstep=3)
+        meta = resumed.restore_auto()
+        assert meta is not None
+        assert meta["epoch"] == 2 and meta["batch_in_epoch"] > 0
+        hist = resumed.train()
+
+        same(ref.params, resumed.params)
+        same(jax.tree.leaves(ref.opt_state), jax.tree.leaves(resumed.opt_state))
+        assert hist["train"][-1] == ref_hist["train"][-1]
+        assert hist["validate"][-1] == ref_hist["validate"][-1]
+
+
+LADDER = ServingConfig(buckets=(1, 2, 4), max_batch=4, max_delay_ms=5.0)
+
+
+@pytest.fixture(scope="module")
+def fleet_setup():
+    """A train-free heterogeneous Forecaster (freshly-initialized params
+    + per-city fitted normalizers) — the same recipe as
+    tests/test_serving.py, lifted to three cities of two shape classes."""
+    cfg = preset("smoke")
+    datas = city_datas()
+    n_nodes = [d.demand.shape[1] for d in datas]
+    sups = [
+        np.asarray(
+            SupportConfig(cfg.model.kernel_type, cfg.model.K).build_all(
+                d.adjs.values()
+            ),
+            np.float32,
+        )[: cfg.model.m_graphs]
+        for d in datas
+    ]
+    model = build_model(cfg, 1)
+    import jax.numpy as jnp
+
+    x = jnp.zeros((2, cfg.data.seq_len, n_nodes[0], 1), jnp.float32)
+    params = model.init(jax.random.key(0), jnp.asarray(sups[0]), x)
+    normalizers = [MinMaxNormalizer.fit(np.asarray(d.demand)) for d in datas]
+    fc = Forecaster(
+        model, params, None, cfg,
+        {"input_dim": 1, "n_nodes": n_nodes}, normalizers,
+    )
+    return fc, sups, n_nodes
+
+
+@pytest.fixture(scope="module")
+def fleet_engine(fleet_setup):
+    fc, sups, _ = fleet_setup
+    eng = fc.fleet_engine(sups, config=LADDER)
+    yield eng
+    eng.close()
+
+
+class TestFleetServing:
+    """One engine, three cities, two classes: bit-parity against the
+    per-city Forecaster and the coalescing the per-city engine can't do."""
+
+    def test_routing_and_buckets(self, fleet_engine):
+        eng = fleet_engine
+        assert eng.n_cities == 3
+        assert eng.buckets == (1, 2, 4)
+        assert eng.class_of(0) == eng.class_of(1) != eng.class_of(2)
+
+    @pytest.mark.parametrize("city", [0, 1, 2])
+    def test_bit_identical_to_forecaster(self, fleet_setup, fleet_engine,
+                                         city):
+        fc, sups, n_nodes = fleet_setup
+        rng = np.random.default_rng(city)
+        h = rng.gamma(2.0, 20.0,
+                      size=(3, fc.seq_len, n_nodes[city], 1)).astype(np.float32)
+        ref = fc.predict(sups[city], h, city=city)
+        np.testing.assert_array_equal(ref, fleet_engine.predict(h, city=city))
+        np.testing.assert_array_equal(
+            ref, fleet_engine.predict_direct(h, city=city))
+
+    def test_oversized_batch_splits(self, fleet_setup, fleet_engine):
+        fc, sups, n_nodes = fleet_setup
+        rng = np.random.default_rng(7)
+        h = rng.gamma(2.0, 20.0,
+                      size=(9, fc.seq_len, n_nodes[0], 1)).astype(np.float32)
+        ref = fc.predict(sups[0], h, city=0)
+        np.testing.assert_array_equal(ref, fleet_engine.predict(h, city=0))
+
+    def test_cross_city_dispatch_coalesces(self, fleet_setup, fleet_engine):
+        """Concurrent requests for the two same-class cities must share
+        at least one dispatch — and stay bit-exact doing it."""
+        fc, sups, n_nodes = fleet_setup
+        eng = fleet_engine
+        before = eng.cross_city_dispatches
+        rng = np.random.default_rng(11)
+        hs = {
+            c: rng.gamma(2.0, 20.0,
+                         size=(2, fc.seq_len, n_nodes[c], 1)).astype(np.float32)
+            for c in (0, 1)
+        }
+        refs = {c: fc.predict(sups[c], hs[c], city=c) for c in (0, 1)}
+        outs = {}
+        barrier = threading.Barrier(2)
+
+        def worker(c):
+            barrier.wait()
+            outs[c] = eng.predict(hs[c], city=c)
+
+        threads = [threading.Thread(target=worker, args=(c,)) for c in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for c in (0, 1):
+            np.testing.assert_array_equal(refs[c], outs[c])
+        assert eng.cross_city_dispatches > before
+
+    def test_unassigned_city_gets_private_class(self, fleet_setup):
+        """A waste budget that strands the small cities still serves
+        them (exact-fit private classes), bit-exact."""
+        fc, sups, n_nodes = fleet_setup
+        with fc.fleet_engine(sups, config=LADDER, max_classes=1,
+                             max_pad_waste=0.05) as eng:
+            assert eng.plan.unassigned == (1, 2)
+            assert eng.class_of(0) != eng.class_of(1) != eng.class_of(2)
+            rng = np.random.default_rng(3)
+            for c in range(3):
+                h = rng.gamma(
+                    2.0, 20.0,
+                    size=(2, fc.seq_len, n_nodes[c], 1)).astype(np.float32)
+                np.testing.assert_array_equal(
+                    fc.predict(sups[c], h, city=c), eng.predict(h, city=c))
+
+    def test_validation_errors(self, fleet_setup, fleet_engine):
+        fc, sups, n_nodes = fleet_setup
+        with pytest.raises(ValueError, match="city"):
+            fleet_engine.predict(
+                np.zeros((1, fc.seq_len, 9, 1), np.float32), city=9)
+        with pytest.raises(ValueError, match="history"):
+            fleet_engine.predict(
+                np.zeros((1, fc.seq_len, 7, 1), np.float32), city=0)
+
+    def test_homogeneous_checkpoint_rejected(self, fleet_setup):
+        fc, sups, n_nodes = fleet_setup
+        flat = Forecaster(fc.model, fc.params, fc.normalizers[0], fc.config,
+                          {"input_dim": 1, "n_nodes": n_nodes[0]})
+        with pytest.raises(ValueError, match="ServingEngine"):
+            FleetServingEngine.from_forecaster(flat, [sups[0]])
+
+    def test_support_shape_mismatch_rejected(self, fleet_setup):
+        fc, sups, _ = fleet_setup
+        with pytest.raises(ValueError, match="support"):
+            FleetServingEngine.from_forecaster(fc, sups[:2])
+        bad = [sups[0], sups[0], sups[2]]  # city 1 stack at the wrong N
+        with pytest.raises(ValueError, match="city 1"):
+            FleetServingEngine.from_forecaster(fc, bad)
+
+
+class TestPlumbing:
+    """Config / CLI / experiment wiring for the fleet knobs."""
+
+    def test_cli_round_trip(self):
+        from stmgcn_tpu.cli import build_parser, config_from_args
+
+        p = build_parser()
+        assert config_from_args(p.parse_args([])).train.fleet is None
+        on = config_from_args(p.parse_args(["--fleet"]))
+        assert on.train.fleet is True
+        off = config_from_args(p.parse_args(["--no-fleet"]))
+        assert off.train.fleet is False
+        knobs = config_from_args(p.parse_args(
+            ["--fleet-max-classes", "3", "--fleet-max-pad-waste", "0.25"]))
+        assert knobs.train.fleet_max_classes == 3
+        assert knobs.train.fleet_max_pad_waste == 0.25
+
+    def test_build_trainer_engages_fleet(self, tmp_path):
+        from stmgcn_tpu.experiment import build_trainer
+
+        cfg = preset("multicity")
+        cfg.data.n_cities = 3
+        cfg.data.city_rows = (3, 3, 2)
+        cfg.data.city_timesteps = (24 * 7 * 2, 24 * 7 * 2 + 12, 24 * 7 * 2)
+        cfg.data.hetero = True
+        cfg.mesh.dp = 1
+        cfg.train.steps_per_superstep = 3
+        cfg.train.epochs = 1
+        cfg.train.out_dir = str(tmp_path)
+        t = build_trainer(cfg, verbose=False)
+        assert t.train_path == "fleet_superstep"
+        assert t._fleet_plan is not None
+        assert sorted(t._fleet_cities) == [0, 1, 2]

@@ -93,7 +93,11 @@ class TestTrainerParity:
         tr = build(tmp_path / "s", placement="stream")
         assert not tr._window_free
 
-    def test_hetero_dataset_refuses_window_free(self, tmp_path):
+    def test_hetero_dataset_supports_window_free(self, tmp_path):
+        """Heterogeneous datasets delegate the window-free protocol per
+        city (data/hetero.py) — once a hard refusal, now the substrate
+        the fleet fast path builds on (tests/test_fleet.py pins the
+        bit-parity)."""
         from stmgcn_tpu.config import preset
         from stmgcn_tpu.experiment import build_trainer
 
@@ -102,9 +106,11 @@ class TestTrainerParity:
         cfg.data.city_timesteps = (24 * 7 * 2 + 24, 24 * 7 * 2)
         cfg.mesh.dp = 1
         cfg.train.window_free = True
+        cfg.train.epochs = 1
         cfg.train.out_dir = str(tmp_path)
-        with pytest.raises(ValueError, match="homogeneous"):
-            build_trainer(cfg, verbose=False)
+        tr = build_trainer(cfg, verbose=False)
+        assert tr._window_free and not tr.dataset.materialized
+        assert tr.dataset.resident_nbytes < tr.dataset.nbytes
 
 
 def test_cli_and_config_plumbing():

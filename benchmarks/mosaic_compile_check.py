@@ -10,7 +10,10 @@ the two concrete kernel failures this tool exists to chase
 
 - fp32 forward kernel: VMEM stack OOM — 18.04 MB scoped allocation vs
   the 16 MB limit at the pre-packing 128-row block calibration
-  (addressed: ``_block_rows`` halved its bases, see ops/pallas_lstm.py);
+  (addressed: ``_block_rows`` halved its bases, see ops/pallas_lstm.py;
+  ``stmgcn lint``'s static Pallas pass — ``analysis/pallas_check.py`` —
+  is calibrated to reproduce this exact 18.04 MB estimate from source
+  alone, so the regression is caught on CPU without the tunnel);
 - bf16: ``infer-vector-layout: unsupported shape cast``
   (``vector<128x64xbf16> -> vector<1x1x128x1x64xbf16>``) somewhere in
   the vmapped lowering of the packed kernel.

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# CI lint gate: stmgcn lint (whole-program + contracts) plus ruff when
+# the image ships it. Stdout is the contract — EXACTLY one JSON line:
+#
+#   {"gate": "PASS"|"FAIL", "lint": {"exit": N, "errors": N,
+#    "warnings": N, "version": N}, "ruff": {"available": true|false,
+#    "exit": N|null}}
+#
+# Everything human-readable (full reports, ruff listing) goes to stderr.
+# Exit 0 iff the gate is PASS: lint found no unsuppressed errors AND
+# ruff (when available) is clean. The stdout shape is pinned by a
+# slow-tier test (tests/test_analysis.py::TestLintGateScript).
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+PY=${PYTHON:-python}
+
+lint_json=$("$PY" -m stmgcn_tpu.cli lint --format json 2>>/dev/stderr)
+lint_exit=$?
+printf '%s\n' "$lint_json" >&2
+
+ruff_available=false
+ruff_exit=null
+if command -v ruff >/dev/null 2>&1; then
+    ruff_available=true
+    ruff check . >&2
+    ruff_exit=$?
+fi
+
+LINT_JSON="$lint_json" LINT_EXIT="$lint_exit" \
+RUFF_AVAILABLE="$ruff_available" RUFF_EXIT="$ruff_exit" \
+"$PY" - <<'EOF'
+import json
+import os
+import sys
+
+try:
+    report = json.loads(os.environ["LINT_JSON"])
+except ValueError:
+    report = {}
+lint_exit = int(os.environ["LINT_EXIT"])
+ruff_available = os.environ["RUFF_AVAILABLE"] == "true"
+ruff_exit = None if os.environ["RUFF_EXIT"] == "null" else int(os.environ["RUFF_EXIT"])
+
+ok = lint_exit == 0 and report.get("errors") == 0
+if ruff_available:
+    ok = ok and ruff_exit == 0
+print(json.dumps({
+    "gate": "PASS" if ok else "FAIL",
+    "lint": {
+        "exit": lint_exit,
+        "errors": report.get("errors"),
+        "warnings": report.get("warnings"),
+        "version": report.get("version"),
+    },
+    "ruff": {"available": ruff_available, "exit": ruff_exit},
+}))
+sys.exit(0 if ok else 1)
+EOF

@@ -11,8 +11,17 @@ by tier-1 (``tests/test_analysis.py``):
   functions, Python control flow on traced values, ``time.time()`` spans
   around device dispatch without a readback fence (the
   :mod:`stmgcn_tpu.utils.profiling` lesson: on the tunneled axon backend
-  an unfenced span times *dispatch*, not compute), and train-step
-  ``jax.jit`` calls missing ``donate_argnums``.
+  an unfenced span times *dispatch*, not compute), train-step
+  ``jax.jit`` calls missing ``donate_argnums``, and per-call-fresh
+  callable identities (``functools.partial`` / bound methods / nested
+  defs) at static argument positions. By default the pass is
+  **whole-program**: :mod:`.program_db` parses every module once,
+  resolves import aliases (including ``__init__`` re-export chains),
+  and propagates jit-reachability across statically resolved
+  inter-module calls, so a host-sync in a helper only *another*
+  module's jitted code reaches is still flagged — with the cross-module
+  call chain attached (``--no-whole-program`` restores the per-module
+  view).
 - **Pass 2 — contract checks** (:mod:`.jaxpr_check`,
   :mod:`.sharding_check`, :mod:`.collective_check`,
   :mod:`.serving_check`): abstractly trace the smoke-preset step
@@ -29,7 +38,11 @@ by tier-1 (``tests/test_analysis.py``):
   engages the fleet path (planner knobs, city coverage, per-class
   resident footprint, :mod:`.fleet_check`), and serving bucket-ladder
   math for every preset (strictly increasing, covers max_batch, pad
-  waste bounded).
+  waste bounded), and static Pallas kernel checks (:mod:`.pallas_check`):
+  grid/BlockSpec divisibility plus a calibrated VMEM-footprint estimate
+  for every ``pl.pallas_call`` site in :mod:`stmgcn_tpu.ops.pallas_lstm`,
+  reproducing the known 18.04 MB fp32-forward Mosaic OOM from source
+  alone.
 
 Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 ``# stmgcn: ignore``) on the offending line.
@@ -39,6 +52,8 @@ from stmgcn_tpu.analysis.collective_check import check_collective_contracts
 from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
+from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
+from stmgcn_tpu.analysis.program_db import ProgramDB
 from stmgcn_tpu.analysis.report import Finding, render_json, render_text
 from stmgcn_tpu.analysis.resident_check import check_resident_memory
 from stmgcn_tpu.analysis.rules import RULES, Rule
@@ -47,10 +62,12 @@ from stmgcn_tpu.analysis.sharding_check import check_partition_specs
 
 __all__ = [
     "Finding",
+    "ProgramDB",
     "RULES",
     "Rule",
     "check_collective_contracts",
     "check_fleet_shape_classes",
+    "check_pallas_kernels",
     "check_partition_specs",
     "check_resident_memory",
     "check_serving_buckets",

@@ -16,10 +16,18 @@ For the halo plan the check estimates the grid (neighborhood) branch's
 support bandwidth a priori: a rows x cols rook grid in row-major order
 has adjacency bandwidth ``cols``, and a K-hop kernel (``chebyshev`` /
 ``random_walk_diffusion`` order K) reaches ``K * cols``; ``localpool``
-is one hop. Only the grid branch has such an a-priori bound — the
-transport/similarity branches' bandwidths are data-dependent, which is
-exactly why ``region_strategy="auto"`` routes them per-branch at
-decomposition time and why this check stays silent about them.
+is one hop. The transport/similarity branches' *exact* bandwidths are
+data-dependent, but their nonzero **counts** are config math
+(:func:`expected_branch_nnz`: the synthetic transport graph draws
+Bernoulli edges at rate ``min(1, 10/n)``, the similarity graph keeps the
+top decile of correlations), and a matrix with bandwidth ``b`` has at
+most ``n * (2b + 1)`` nonzeros under *any* node ordering — so
+:func:`branch_bandwidth_floor` is a sound worst-case lower bound on the
+bandwidth any reordering can achieve. When ``region_strategy="banded"``
+is *forced* (``"auto"`` routes dense branches away at decomposition
+time), a floor above the halo budget means strip decomposition must
+drop neighbors regardless of how the decomposer orders nodes — flagged
+up front instead of surfacing as accuracy loss on the mesh.
 """
 
 from __future__ import annotations
@@ -29,7 +37,12 @@ from typing import Iterable, List, Optional, Tuple
 from stmgcn_tpu.analysis.report import Finding
 from stmgcn_tpu.analysis.rules import RULES
 
-__all__ = ["check_collective_contracts", "grid_bandwidth_estimate"]
+__all__ = [
+    "branch_bandwidth_floor",
+    "check_collective_contracts",
+    "expected_branch_nnz",
+    "grid_bandwidth_estimate",
+]
 
 _K_HOP_KERNELS = ("chebyshev", "random_walk_diffusion")
 
@@ -43,6 +56,35 @@ def grid_bandwidth_estimate(kernel_type: str, K: int, cols: int) -> int:
     """
     hops = K if kernel_type in _K_HOP_KERNELS else 1
     return hops * cols
+
+
+def expected_branch_nnz(kind: str, n: int) -> int:
+    """Worst-case nonzero count of a data-dependent branch support.
+
+    ``transport``: the synthetic builder draws directed Bernoulli edges
+    at rate ``p = min(1, 10/n)`` and symmetrizes, so an (i, j) entry is
+    present with probability ``<= 2p`` — worst case ``min(n*n, 20*n)``
+    nonzeros. ``similarity``: the builder thresholds at the top decile
+    of pairwise correlations, exactly ``ceil(0.1 * n*n)`` entries.
+    """
+    if kind == "transport":
+        return min(n * n, 20 * n)
+    if kind == "similarity":
+        return -(-(n * n) // 10)
+    raise ValueError(f"unknown data-dependent branch kind: {kind!r}")
+
+
+def branch_bandwidth_floor(n: int, nnz: int) -> int:
+    """Lower bound on achievable bandwidth for any ordering of an
+    ``n x n`` support with ``nnz`` nonzeros.
+
+    A matrix with bandwidth ``b`` has at most ``n * (2b + 1)`` nonzeros,
+    so ``b >= (nnz/n - 1) / 2`` no matter how the decomposer permutes
+    nodes — the a-priori bound the grid branch gets from geometry, the
+    dense branches get from counting.
+    """
+    per_row = -(-nnz // n)  # ceil: the densest row is at least the mean
+    return max(0, -(-(per_row - 1) // 2))
 
 
 def _city_grids(cfg) -> List[Tuple[int, int]]:
@@ -143,4 +185,28 @@ def check_collective_contracts(
                     "strip_decompose would drop boundary neighbors; use "
                     "'auto' or raise mesh.halo",
                 )
+            if mesh.region_strategy != "banded":
+                continue
+            # forced banded routes the data-dependent branches through
+            # strip decomposition too — gate on their counting floor
+            # (branch order: 0 grid, 1 transport, 2 similarity)
+            present = []
+            if cfg.model.m_graphs >= 2:
+                present.append("transport")
+            if cfg.model.m_graphs >= 3:
+                present.append("similarity")
+            for kind in present:
+                floor = branch_bandwidth_floor(
+                    n, expected_branch_nnz(kind, n)
+                )
+                if floor > budget:
+                    emit(
+                        name,
+                        f"{name}: region_strategy='banded' but the {kind} "
+                        f"branch's bandwidth floor {floor} (worst-case "
+                        f"{expected_branch_nnz(kind, n)} nnz over {n} "
+                        f"nodes; no ordering can do better) exceeds the "
+                        f"halo budget {budget} — strip_decompose must "
+                        "drop neighbors; use 'auto' or raise mesh.halo",
+                    )
     return findings

@@ -12,18 +12,28 @@ only make sense under trace (``host-sync-in-jit``, ``traced-control-flow``)
 fire only inside reachable functions, which is what keeps host-side
 pre-processing (support building, metrics, checkpointing) out of scope.
 
-Reachability is deliberately per-module: cross-module call graphs over a
-dynamically-dispatched codebase produce exactly the false positives that
-make a linter get turned off. The contract pass (:mod:`.jaxpr_check`)
-covers the cross-module hot path by tracing it for real.
+Reachability *propagation* is per-module here; whole-program mode
+(:func:`lint_package` with ``whole_program=True``, the default) injects
+extra reachable functions computed by :mod:`.program_db`'s global call
+graph — but only through statically resolved imports, never dynamic
+dispatch, so the promotion adds reachability without adding the false
+positives that make a linter get turned off. Findings in functions that
+are only *globally* reachable carry the root→function call chain. The
+contract pass (:mod:`.jaxpr_check`) still covers the hot path by
+tracing it for real.
 
 Suppression: ``# stmgcn: ignore[rule-id]`` (or bare ``# stmgcn: ignore``)
-on the finding's line.
+on the finding's line — the *reported* line, which for a cross-module
+finding is where the offending call sits, not where the jit root lives.
+``include_suppressed=True`` keeps suppressed findings in the output
+(marked, never counted) for audit via ``--format json
+--include-suppressed``.
 """
 
 from __future__ import annotations
 
 import ast
+import dataclasses
 import os
 import re
 from pathlib import Path
@@ -164,16 +174,27 @@ class _ModuleIndex(ast.NodeVisitor):
 
 
 class _Linter:
-    def __init__(self, tree: ast.Module, path: str):
+    def __init__(
+        self,
+        tree: ast.Module,
+        path: str,
+        extra_reachable: Optional[Dict[str, tuple]] = None,
+    ):
         self.path = path
         self.findings: List[Finding] = []
         self.index = _ModuleIndex()
         self.index.visit(tree)
         # late seeding: functions defined after the call that jits them
         self.reachable = self.index.reachable()
+        # whole-program promotion: functions reachable only through the
+        # global call graph, each carrying its root->function chain
+        self.chains: Dict[str, tuple] = dict(extra_reachable or {})
+        self.reachable |= set(self.chains) & set(self.index.funcs)
         self.tree = tree
 
-    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+    def _emit(
+        self, rule: str, node: ast.AST, message: str, chain: tuple = ()
+    ) -> None:
         self.findings.append(
             Finding(
                 rule=rule,
@@ -182,6 +203,7 @@ class _Linter:
                 col=getattr(node, "col_offset", -1) + 1,
                 message=message,
                 severity=RULES[rule].severity,
+                chain=chain,
             )
         )
 
@@ -194,6 +216,7 @@ class _Linter:
         self._check_compat_attrs()
         self._check_donate()
         self._check_recompile_hazard()
+        self._check_closure_identity()
         return self.findings
 
     # -- jax-compat-import -------------------------------------------------
@@ -250,13 +273,16 @@ class _Linter:
         return None
 
     def _check_traced_body(self, fn) -> None:
+        chain = self.chains.get(fn.name, ())
+        via = " (cross-module)" if chain else ""
         for node in ast.walk(fn):
             if isinstance(node, ast.Call):
                 why = self._is_host_sync(node)
                 if why:
                     self._emit(
                         "host-sync-in-jit", node,
-                        f"{why} inside jit-reachable `{fn.name}`",
+                        f"{why} inside jit-reachable `{fn.name}`{via}",
+                        chain=chain,
                     )
             elif isinstance(node, (ast.If, ast.While)):
                 traced = self._traced_test(node.test)
@@ -265,8 +291,9 @@ class _Linter:
                     self._emit(
                         "traced-control-flow", node,
                         f"Python `{kw}` on traced value ({traced}) in "
-                        f"jit-reachable `{fn.name}` — use jnp.where / "
+                        f"jit-reachable `{fn.name}`{via} — use jnp.where / "
                         "lax.cond / lax.while_loop",
+                        chain=chain,
                     )
 
     def _traced_test(self, test: ast.AST) -> Optional[str]:
@@ -386,27 +413,9 @@ class _Linter:
             if isinstance(sub, ast.Constant) and isinstance(sub.value, typ)
         }
 
-    def _check_recompile_hazard(self) -> None:
-        # sweep A: ``jax.jit(f)(...)`` invoked in place — a fresh wrapper
-        # (with an empty trace cache) every evaluation. Binding the wrapper
-        # (``g = jax.jit(f)``, the factory pattern) is the fix and is not
-        # flagged.
-        for node in ast.walk(self.tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Call)
-                and self._is_jit(node.func.func)
-            ):
-                self._emit(
-                    "recompile-hazard", node,
-                    "jax.jit(...) invoked in place — every evaluation builds "
-                    "a fresh wrapper with an empty trace cache; bind the "
-                    "jitted function once and reuse it",
-                )
-        # sweep B: fresh/unhashable literals handed to a jitted wrapper's
-        # static positions. First map ``g = jax.jit(f, static_argnums=...)``
-        # assignments to their static positions/names, then flag calls of
-        # ``g`` that pass a per-call-fresh object there.
+    def _static_jit_map(self) -> Dict[str, tuple]:
+        """``wrapper name -> (static argnums, static argnames)`` for every
+        ``g = jax.jit(f, static_argnums=.../static_argnames=...)``."""
         static: Dict[str, tuple] = {}
         for node in ast.walk(self.tree):
             if not (
@@ -427,6 +436,29 @@ class _Linter:
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     static[t.id] = (nums, names)
+        return static
+
+    def _check_recompile_hazard(self) -> None:
+        # sweep A: ``jax.jit(f)(...)`` invoked in place — a fresh wrapper
+        # (with an empty trace cache) every evaluation. Binding the wrapper
+        # (``g = jax.jit(f)``, the factory pattern) is the fix and is not
+        # flagged.
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Call)
+                and self._is_jit(node.func.func)
+            ):
+                self._emit(
+                    "recompile-hazard", node,
+                    "jax.jit(...) invoked in place — every evaluation builds "
+                    "a fresh wrapper with an empty trace cache; bind the "
+                    "jitted function once and reuse it",
+                )
+        # sweep B: fresh/unhashable literals handed to a jitted wrapper's
+        # static positions — flag calls of ``g = jax.jit(f, static_*=...)``
+        # that pass a per-call-fresh object there.
+        static = self._static_jit_map()
         if not static:
             return
 
@@ -456,9 +488,129 @@ class _Linter:
                 if kw.arg in names and isinstance(kw.value, self._FRESH_NODES):
                     flag(node, kw.value, f"argname `{kw.arg}`")
 
+    # -- closure-identity --------------------------------------------------
+    def _fresh_callable(self, arg: ast.AST, nested_defs: Set[str]):
+        """Why ``arg`` is a per-call-fresh callable identity, or None.
 
-def lint_source(source: str, path: str = "<string>") -> List[Finding]:
-    """Lint one module's source text; returns surviving findings."""
+        The literal cases (lambda/list/dict) belong to recompile-hazard;
+        this rule covers the identities the literal sweep can't see:
+        ``functools.partial(...)`` builds a new object per evaluation,
+        ``obj.method`` binds a fresh method object per attribute access
+        (only flagged when the attribute names a def in this module —
+        plain value attributes stay out of scope), and a def nested in
+        the calling function is a fresh closure per outer call.
+        """
+        if isinstance(arg, ast.Call):
+            d = self.index.dotted(arg.func)
+            if d and d.split(".")[-1] == "partial" and (
+                d.startswith("functools.") or d == "partial"
+            ):
+                return "functools.partial(...) — a new partial object"
+        if isinstance(arg, ast.Attribute) and arg.attr in self.index.funcs:
+            return (
+                f"bound method `.{arg.attr}` — a fresh method object per "
+                "attribute access"
+            )
+        if isinstance(arg, ast.Name) and arg.id in nested_defs:
+            return (
+                f"nested def `{arg.id}` — a fresh closure per call of the "
+                "enclosing function"
+            )
+        return None
+
+    def _check_closure_identity(self) -> None:
+        # sweep A: fresh callable identities at static positions of mapped
+        # jitted wrappers (the identities recompile-hazard's literal-only
+        # sweep misses)
+        static = self._static_jit_map()
+
+        def check_call(call: ast.Call, nested: Set[str]) -> None:
+            if not (
+                isinstance(call.func, ast.Name) and call.func.id in static
+            ):
+                return
+            nums, names = static[call.func.id]
+
+            def flag(value: ast.AST, why: str, where: str) -> None:
+                self._emit(
+                    "closure-identity", value,
+                    f"{why} at static {where} of jitted `{call.func.id}` — "
+                    "every call presents a new identity to the trace cache "
+                    "and silently retraces; hoist it to a stable binding",
+                )
+
+            for i, arg in enumerate(call.args):
+                if i in nums:
+                    why = self._fresh_callable(arg, nested)
+                    if why:
+                        flag(arg, why, f"position {i}")
+            for kw in call.keywords:
+                if kw.arg in names:
+                    why = self._fresh_callable(kw.value, nested)
+                    if why:
+                        flag(kw.value, why, f"argname `{kw.arg}`")
+
+        if static:
+            seen_calls: Set[int] = set()
+            for outer in ast.walk(self.tree):
+                if not isinstance(
+                    outer, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                nested = {
+                    d.name
+                    for d in ast.walk(outer)
+                    if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and d is not outer
+                }
+                for call in ast.walk(outer):
+                    if isinstance(call, ast.Call):
+                        seen_calls.add(id(call))
+                        check_call(call, nested)
+            for call in ast.walk(self.tree):
+                if isinstance(call, ast.Call) and id(call) not in seen_calls:
+                    check_call(call, set())  # module scope: no nested defs
+
+        # sweep B: ``g = jax.jit(f)`` bound inside a loop body — a fresh
+        # wrapper (empty trace cache) every iteration. The AOT idiom
+        # ``jax.jit(f).lower(...).compile()`` in a loop is deliberately
+        # exempt: the value assigned there is the *compiled* program, and
+        # tracing per shape bucket is the point (serving/engine.py).
+        flagged: Set[int] = set()
+        for loop in ast.walk(self.tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            for node in ast.walk(loop):
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and self._is_jit(node.value.func)
+                    and id(node) not in flagged
+                ):
+                    flagged.add(id(node))
+                    self._emit(
+                        "closure-identity", node,
+                        "jax.jit bound inside a loop body — every iteration "
+                        "builds a fresh wrapper with an empty trace cache; "
+                        "bind once outside the loop (AOT per-shape "
+                        "compilation via .lower().compile() is the "
+                        "loop-safe form)",
+                    )
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    *,
+    extra_reachable: Optional[Dict[str, tuple]] = None,
+    include_suppressed: bool = False,
+) -> List[Finding]:
+    """Lint one module's source text.
+
+    ``extra_reachable`` maps function names to cross-module call chains
+    (whole-program promotion); ``include_suppressed`` keeps suppressed
+    findings, marked, instead of dropping them.
+    """
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -468,17 +620,22 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
                 message=f"unparseable module: {e.msg}", severity="error",
             )
         ]
-    findings = _Linter(tree, path).run()
+    findings = _Linter(tree, path, extra_reachable=extra_reachable).run()
     suppress = _suppressions(source)
     out = []
     for f in findings:
         rules = suppress.get(f.line, ...)
-        if rules is ... or (rules is not None and f.rule not in rules):
+        live = rules is ... or (rules is not None and f.rule not in rules)
+        if live:
             out.append(f)
+        elif include_suppressed:
+            out.append(dataclasses.replace(f, suppressed=True))
     return out
 
 
-def lint_paths(paths: Iterable) -> List[Finding]:
+def lint_paths(
+    paths: Iterable, *, include_suppressed: bool = False
+) -> List[Finding]:
     """Lint ``.py`` files / directory trees; paths become repo-relative."""
     findings: List[Finding] = []
     cwd = os.getcwd()
@@ -492,14 +649,57 @@ def lint_paths(paths: Iterable) -> List[Finding]:
     for f in files:
         rel = os.path.relpath(f, cwd)
         rel = f.as_posix() if rel.startswith("..") else Path(rel).as_posix()
-        findings.extend(lint_source(f.read_text(), rel))
+        findings.extend(
+            lint_source(f.read_text(), rel,
+                        include_suppressed=include_suppressed)
+        )
     return findings
 
 
-def lint_package(root: Optional[str] = None) -> List[Finding]:
-    """Lint the shipped ``stmgcn_tpu`` package (the tier-1 contract)."""
+def lint_package(
+    root: Optional[str] = None,
+    *,
+    whole_program: bool = True,
+    include_suppressed: bool = False,
+) -> List[Finding]:
+    """Lint the shipped ``stmgcn_tpu`` package (the tier-1 contract).
+
+    ``whole_program=True`` (the default) first builds the repo-wide
+    program database (:mod:`.program_db`) and promotes functions that
+    are jit-reachable only through resolved cross-module calls; their
+    findings carry the root→function chain. ``whole_program=False`` is
+    the per-module escape hatch (``stmgcn lint --no-whole-program``).
+    """
     if root is None:
         import stmgcn_tpu
 
         root = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
-    return lint_paths([root])
+    if not whole_program:
+        return lint_paths([root], include_suppressed=include_suppressed)
+
+    from stmgcn_tpu.analysis.program_db import ProgramDB
+
+    db = ProgramDB.from_root(root)
+    findings: List[Finding] = []
+    for name, entry in sorted(db.modules.items()):
+        findings.extend(
+            lint_source(
+                entry.source,
+                entry.path,
+                extra_reachable=db.module_extras(name),
+                include_suppressed=include_suppressed,
+            )
+        )
+    # files the parser rejected never made it into the DB — lint them
+    # per-module so the unparseable-module finding still surfaces
+    indexed = {e.path for e in db.modules.values()}
+    cwd = os.getcwd()
+    for f in sorted(Path(root).rglob("*.py")):
+        rel = os.path.relpath(f, cwd)
+        rel = f.as_posix() if rel.startswith("..") else Path(rel).as_posix()
+        if rel not in indexed:
+            findings.extend(
+                lint_source(f.read_text(), rel,
+                            include_suppressed=include_suppressed)
+            )
+    return findings

@@ -1,0 +1,505 @@
+"""Pass 2h: static Pallas kernel checks — BlockSpec/grid math + VMEM.
+
+``benchmarks/mosaic_compile_check.py`` catches kernel sizing mistakes by
+*really compiling* under Mosaic, which needs the axon tunnel's AOT path
+to answer. This pass is the static approximation that gates earlier: it
+parses ``ops/pallas_lstm.py``, extracts every ``pl.pallas_call`` site
+(grid expression, per-operand ``BlockSpec`` shapes and index maps,
+``out_shape`` structs), evaluates them against the shape arithmetic of
+the enclosing function at a concrete kernel point, and checks
+
+- **pallas-blockspec**: spec/operand arity, rank agreement, per-axis
+  divisibility (every operand dim must be a multiple of its block dim),
+  and grid coverage (``grid[0] * block_rows`` equals the padded rows on
+  the streamed axis);
+- **pallas-vmem**: a footprint estimate against the ~16 MiB/core scoped
+  VMEM budget. Blocks whose index map uses the grid index are *streamed*
+  and double-buffered by the pipeline (×2); constant-index blocks are
+  resident once. The model is ``CALIBRATION × (2 × streamed_bytes +
+  resident_bytes)``, with the calibration constant fitted to the one
+  piece of real Mosaic AOT evidence this repo owns: the fp32 forward
+  kernel at the pre-packing 128-row block allocating **18.04 MB vs the
+  16 MB limit** (bench_stderr.log 2026-07-29, reproduced by
+  ``mosaic_compile_check.py``). The constant absorbs what the block
+  arithmetic can't see — kernel temporaries of the unrolled T×L
+  recurrence and Mosaic's own stack — and the model is validated in both
+  directions: it must flag that OOM point and pass every shipped
+  ``_block_rows``-sized kernel (tests/test_analysis.py pins both).
+
+The extraction is genuinely syntactic — edit a BlockSpec in
+``ops/pallas_lstm.py`` and this pass re-derives the math from the new
+source. If the source drifts past what the evaluator understands (new
+variable names, a new pallas_call site), the check fails loudly with a
+``pallas-blockspec`` out-of-sync finding rather than silently passing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import math
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = [
+    "KernelPoint",
+    "PallasSite",
+    "VMEM_BUDGET_BYTES",
+    "check_pallas_kernels",
+    "extract_pallas_sites",
+    "vmem_estimate",
+]
+
+#: per-core scoped VMEM the Mosaic pipeline may allocate (v5e guide)
+VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+#: streamed (grid-indexed) blocks are double-buffered by the pipeline
+PIPELINE_FACTOR = 2
+
+#: fitted so the fp32 forward kernel at the historical 128-row block
+#: (T=12, L=3, H=64) estimates 18.04 MiB — the allocation real Mosaic
+#: AOT reported for exactly that configuration. One real observation,
+#: one free constant; everything else is block arithmetic.
+CALIBRATION = 2.1064
+
+_ITEMSIZE = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelPoint:
+    """One concrete kernel configuration to check the sites against.
+
+    Defaults are the canonical bench point (``benchmarks/bench.py``:
+    M=3 branches over R=16384 rows, T=12, L=3, H=64). ``fwd_rows`` /
+    ``bwd_rows`` override the ``_block_rows`` derivation — that is how
+    the known-OOM fixture reconstructs the pre-halving calibration.
+    """
+
+    dtype: str = "float32"
+    seq_len: int = 12
+    layers: int = 3
+    hidden: int = 64
+    rows: int = 16384
+    fwd_rows: Optional[int] = None
+    bwd_rows: Optional[int] = None
+
+    @property
+    def itemsize(self) -> int:
+        return _ITEMSIZE[self.dtype]
+
+    def block_rows(self) -> Tuple[int, int]:
+        fwd, bwd = self.fwd_rows, self.bwd_rows
+        if fwd is None or bwd is None:
+            # the real derivation (env overrides included) — the checker
+            # validates the configuration the kernel would actually run
+            from stmgcn_tpu.ops.pallas_lstm import _block_rows
+
+            dfwd, dbwd = _block_rows(self.itemsize, self.seq_len, self.layers)
+            fwd = dfwd if fwd is None else fwd
+            bwd = dbwd if bwd is None else bwd
+        return fwd, bwd
+
+    def describe(self) -> str:
+        return (
+            f"{self.dtype} T={self.seq_len} L={self.layers} H={self.hidden}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockUse:
+    """One operand's block at one site: shape, full shape, streaming."""
+
+    operand: str
+    block: Tuple[int, ...]
+    operand_shape: Tuple[int, ...]
+    itemsize: int
+    streamed: bool
+    streamed_axis: Optional[int]
+
+    @property
+    def nbytes(self) -> int:
+        return int(math.prod(self.block)) * self.itemsize
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasSite:
+    """One ``pl.pallas_call`` call site, still as AST."""
+
+    fn: str  # enclosing function name
+    path: str
+    line: int
+    grid: ast.expr
+    in_specs: List[ast.expr]
+    out_specs: List[ast.expr]
+    out_shape: List[ast.expr]
+    operands: List[str]  # names of the arrays the wrapped call receives
+
+
+class _Unresolved(Exception):
+    """The evaluator met a name/construct outside the site's env."""
+
+
+def _ev(node: ast.AST, names: Dict[str, object]):
+    """Tiny shape-arithmetic evaluator (ints, tuples, +,-,*,//,%, max)."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in names:
+            return names[node.id]
+        raise _Unresolved(node.id)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(_ev(e, names) for e in node.elts)
+    if isinstance(node, ast.Attribute):
+        parts = []
+        cur: ast.AST = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            dotted = ".".join([cur.id] + list(reversed(parts)))
+            if dotted in names:
+                return names[dotted]
+        raise _Unresolved(ast.dump(node))
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = _ev(node.left, names), _ev(node.right, names)
+        ops = {
+            ast.Add: lambda a, b: a + b,
+            ast.Sub: lambda a, b: a - b,
+            ast.Mult: lambda a, b: a * b,
+            ast.FloorDiv: lambda a, b: a // b,
+            ast.Mod: lambda a, b: a % b,
+        }
+        fn = ops.get(type(node.op))
+        if fn is None:
+            raise _Unresolved(ast.dump(node.op))
+        return fn(lhs, rhs)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_ev(node.operand, names)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id == "max":
+            return max(_ev(a, names) for a in node.args)
+        if node.func.id == "min":
+            return min(_ev(a, names) for a in node.args)
+    raise _Unresolved(ast.dump(node))
+
+
+def _default_kernel_path() -> str:
+    import stmgcn_tpu
+
+    pkg = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
+    return os.path.join(pkg, "ops", "pallas_lstm.py")
+
+
+def extract_pallas_sites(path: Optional[str] = None) -> List[PallasSite]:
+    """AST-extract every ``pl.pallas_call`` site in ``path`` (default:
+    the shipped ``ops/pallas_lstm.py``). Pure syntax — no jax import."""
+    from stmgcn_tpu.analysis.lint import _ModuleIndex
+
+    path = path or _default_kernel_path()
+    source = open(path).read()
+    tree = ast.parse(source)
+    index = _ModuleIndex()
+    index.visit(tree)
+
+    rel = os.path.relpath(path, os.getcwd())
+    rel = path if rel.startswith("..") else rel.replace(os.sep, "/")
+
+    sites: List[PallasSite] = []
+
+    class _Finder(ast.NodeVisitor):
+        def __init__(self):
+            self._stack: List[str] = []
+
+        def _handle_func(self, node):
+            self._stack.append(node.name)
+            self.generic_visit(node)
+            self._stack.pop()
+
+        visit_FunctionDef = _handle_func
+        visit_AsyncFunctionDef = _handle_func
+
+        def visit_Call(self, node: ast.Call) -> None:
+            # shape: pl.pallas_call(kernel, grid=..., ...)(op0, op1, ...)
+            if isinstance(node.func, ast.Call):
+                d = index.dotted(node.func.func)
+                if d and d.split(".")[-1] == "pallas_call":
+                    inner = node.func
+                    kw = {k.arg: k.value for k in inner.keywords}
+                    operands = [
+                        a.id if isinstance(a, ast.Name) else f"<arg{i}>"
+                        for i, a in enumerate(node.args)
+                    ]
+
+                    def elts(name):
+                        v = kw.get(name)
+                        if isinstance(v, (ast.Tuple, ast.List)):
+                            return list(v.elts)
+                        return [] if v is None else [v]
+
+                    sites.append(
+                        PallasSite(
+                            fn=self._stack[-1] if self._stack else "<module>",
+                            path=rel,
+                            line=node.lineno,
+                            grid=kw.get("grid"),
+                            in_specs=elts("in_specs"),
+                            out_specs=elts("out_specs"),
+                            out_shape=elts("out_shape"),
+                            operands=operands,
+                        )
+                    )
+            self.generic_visit(node)
+
+    _Finder().visit(tree)
+    return sites
+
+
+def _round_up(n: int, block: int) -> int:
+    return -(-n // block) * block
+
+
+def _site_env(site: PallasSite, point: KernelPoint) -> Dict[str, object]:
+    """The enclosing function's shape bindings at ``point`` — mirrors
+    the arithmetic of ``_run_fwd`` / ``_fused_bwd`` in ops/pallas_lstm.py.
+    Unknown sites raise :class:`_Unresolved` (checker out of sync)."""
+    H, T, L = point.hidden, point.seq_len, point.layers
+    four_h, h_dim = 4 * H, H
+    fwd_block, bwd_block = point.block_rows()
+    wxh_shape = (max(L - 1, 1), 2 * H, 4 * H)
+    b_shape = (max(L - 1, 1), 4 * H)
+    common = {
+        "T": T, "L": L, "four_h": four_h, "h_dim": h_dim,
+        "wxh.shape": wxh_shape, "b_stack.shape": b_shape,
+        # out_shape dtypes: storage dtype or the kernel's f32 accumulators
+        "dtype": point.itemsize, "f32": 4,
+    }
+    if site.fn == "_run_fwd":
+        rp = _round_up(point.rows, fwd_block)
+        shapes = {
+            "xp": (T, rp, four_h),
+            "wh0": (h_dim, four_h),
+            "wxh": wxh_shape,
+            "b_stack": b_shape,
+        }
+        return {**common, "block_fwd": fwd_block, "rp": rp,
+                "grid": (rp // fwd_block,), "__shapes__": shapes}
+    if site.fn == "_fused_bwd":
+        rp = _round_up(point.rows, bwd_block)
+        rp_fwd = _round_up(point.rows, fwd_block)  # residual padding
+        shapes = {
+            "xp": (T, rp, four_h),
+            "wh0": (h_dim, four_h),
+            "wxh": wxh_shape,
+            "b_stack": b_shape,
+            "hseq": (T, L, rp_fwd, h_dim),
+            "cseq": (T, L, rp_fwd, h_dim),
+            "gout": (T, rp, h_dim),
+            "ghfin": (L, rp, h_dim),
+            "gcfin": (L, rp, h_dim),
+        }
+        return {**common, "block_bwd": bwd_block, "rp": rp,
+                "grid": (rp // bwd_block,), "__shapes__": shapes}
+    raise _Unresolved(f"unknown pallas_call site `{site.fn}`")
+
+
+def _spec_parts(spec: ast.expr) -> Tuple[ast.expr, Optional[ast.Lambda]]:
+    """``pl.BlockSpec(shape, index_map)`` -> (shape expr, lambda|None)."""
+    if not isinstance(spec, ast.Call):
+        raise _Unresolved(ast.dump(spec))
+    shape = spec.args[0] if spec.args else None
+    imap = spec.args[1] if len(spec.args) > 1 else None
+    for k in spec.keywords:
+        if k.arg in ("block_shape",):
+            shape = k.value
+        elif k.arg in ("index_map",):
+            imap = k.value
+    if shape is None:
+        raise _Unresolved("BlockSpec without a block shape")
+    if imap is not None and not isinstance(imap, ast.Lambda):
+        raise _Unresolved("non-lambda index_map")
+    return shape, imap
+
+
+def _streamed_axis(imap: Optional[ast.Lambda]) -> Optional[int]:
+    """Index of the block axis driven by the grid index; None = constant.
+
+    ``lambda i: (0, i, 0)`` streams axis 1; an index map that ignores its
+    parameter revisits one block every grid step (resident/accumulator).
+    """
+    if imap is None or not imap.args.args:
+        return None
+    param = imap.args.args[0].arg
+    body = imap.body
+    elts = body.elts if isinstance(body, (ast.Tuple, ast.List)) else [body]
+    for axis, e in enumerate(elts):
+        if any(
+            isinstance(s, ast.Name) and s.id == param for s in ast.walk(e)
+        ):
+            return axis
+    return None
+
+
+def _site_blocks(
+    site: PallasSite, point: KernelPoint
+) -> Tuple[Tuple[int, ...], List[BlockUse]]:
+    """Evaluate the site at ``point`` -> (grid, every operand's block)."""
+    env = _site_env(site, point)
+    names = {k: v for k, v in env.items() if k != "__shapes__"}
+    op_shapes: Dict[str, Tuple[int, ...]] = env["__shapes__"]
+
+    grid_v = _ev(site.grid, names) if site.grid is not None else (1,)
+    grid = tuple(grid_v) if isinstance(grid_v, tuple) else (int(grid_v),)
+
+    uses: List[BlockUse] = []
+    if len(site.in_specs) != len(site.operands):
+        raise _Unresolved(
+            f"{site.fn}: {len(site.in_specs)} in_specs for "
+            f"{len(site.operands)} operands"
+        )
+    for spec, operand in zip(site.in_specs, site.operands):
+        shape_e, imap = _spec_parts(spec)
+        block = tuple(_ev(shape_e, names))
+        if operand not in op_shapes:
+            raise _Unresolved(f"{site.fn}: unknown operand `{operand}`")
+        axis = _streamed_axis(imap)
+        uses.append(
+            BlockUse(operand, block, op_shapes[operand], point.itemsize,
+                     axis is not None, axis)
+        )
+    if len(site.out_specs) != len(site.out_shape):
+        raise _Unresolved(
+            f"{site.fn}: {len(site.out_specs)} out_specs for "
+            f"{len(site.out_shape)} out_shape structs"
+        )
+    for i, (spec, struct) in enumerate(zip(site.out_specs, site.out_shape)):
+        shape_e, imap = _spec_parts(spec)
+        block = tuple(_ev(shape_e, names))
+        if not (isinstance(struct, ast.Call) and len(struct.args) >= 2):
+            raise _Unresolved(f"{site.fn}: out_shape[{i}] not a struct")
+        full = tuple(_ev(struct.args[0], names))
+        itemsize = int(_ev(struct.args[1], names))
+        axis = _streamed_axis(imap)
+        uses.append(
+            BlockUse(f"<out{i}>", block, full, itemsize,
+                     axis is not None, axis)
+        )
+    return grid, uses
+
+
+def vmem_estimate(site: PallasSite, point: KernelPoint) -> Dict[str, float]:
+    """The calibrated footprint model at ``point`` (bytes + MiB views)."""
+    _, uses = _site_blocks(site, point)
+    streamed = sum(u.nbytes for u in uses if u.streamed)
+    resident = sum(u.nbytes for u in uses if not u.streamed)
+    est = CALIBRATION * (PIPELINE_FACTOR * streamed + resident)
+    return {
+        "site": site.fn,
+        "streamed_bytes": streamed,
+        "resident_bytes": resident,
+        "estimate_bytes": est,
+        "estimate_mib": est / (1 << 20),
+        "budget_bytes": VMEM_BUDGET_BYTES,
+    }
+
+
+def _check_site(site: PallasSite, point: KernelPoint) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def emit(rule: str, message: str) -> None:
+        findings.append(
+            Finding(rule=rule, path=site.path, line=site.line,
+                    message=message, severity=RULES[rule].severity)
+        )
+
+    try:
+        grid, uses = _site_blocks(site, point)
+    except _Unresolved as e:
+        emit(
+            "pallas-blockspec",
+            f"`{site.fn}` pallas_call: static checker out of sync with the "
+            f"source ({e}) — update analysis/pallas_check.py alongside the "
+            "kernel",
+        )
+        return findings
+
+    for u in uses:
+        if len(u.block) != len(u.operand_shape):
+            emit(
+                "pallas-blockspec",
+                f"`{site.fn}` [{point.describe()}]: operand `{u.operand}` "
+                f"block rank {len(u.block)} != operand rank "
+                f"{len(u.operand_shape)}",
+            )
+            continue
+        for axis, (b, full) in enumerate(zip(u.block, u.operand_shape)):
+            if b <= 0 or full % b:
+                emit(
+                    "pallas-blockspec",
+                    f"`{site.fn}` [{point.describe()}]: operand "
+                    f"`{u.operand}` axis {axis} block {b} does not divide "
+                    f"the operand dim {full} — Mosaic pads or rejects the "
+                    "ragged final block",
+                )
+        if u.streamed and u.streamed_axis is not None:
+            axis = u.streamed_axis
+            if axis < len(u.block) and grid:
+                covered = grid[0] * u.block[axis]
+                if covered != u.operand_shape[axis]:
+                    emit(
+                        "pallas-blockspec",
+                        f"`{site.fn}` [{point.describe()}]: grid {grid[0]} x "
+                        f"block {u.block[axis]} covers {covered} of "
+                        f"{u.operand_shape[axis]} rows of `{u.operand}` — "
+                        "the kernel would read/write a row range it was "
+                        "never given",
+                    )
+
+    est = vmem_estimate(site, point)
+    if est["estimate_bytes"] > VMEM_BUDGET_BYTES:
+        emit(
+            "pallas-vmem",
+            f"`{site.fn}` [{point.describe()}]: estimated VMEM footprint "
+            f"{est['estimate_mib']:.2f} MiB exceeds the "
+            f"{VMEM_BUDGET_BYTES >> 20} MiB/core scoped budget "
+            f"(2x-buffered streamed blocks {est['streamed_bytes']} B + "
+            f"resident blocks {est['resident_bytes']} B, calibration "
+            f"x{CALIBRATION}) — shrink the block rows "
+            "(STMGCN_PALLAS_FWD_ROWS/BWD_ROWS) or the block shapes",
+        )
+    return findings
+
+
+def check_pallas_kernels(
+    points: Optional[Iterable[KernelPoint]] = None,
+    path: Optional[str] = None,
+) -> List[Finding]:
+    """Check every extracted pallas_call site at every ``point``.
+
+    Default points: the bench configuration in both storage dtypes, with
+    blocks derived by the kernel's own ``_block_rows`` (env overrides
+    included, so an operator's ``STMGCN_PALLAS_FWD_ROWS`` experiment is
+    checked as configured).
+    """
+    if points is None:
+        points = [KernelPoint(dtype="float32"), KernelPoint(dtype="bfloat16")]
+    sites = extract_pallas_sites(path)
+    if not sites:
+        return [
+            Finding(
+                rule="pallas-blockspec",
+                path=path or _default_kernel_path(),
+                line=0,
+                message="no pl.pallas_call site found — the kernel moved "
+                "and the static checker lost it; update "
+                "analysis/pallas_check.py",
+                severity=RULES["pallas-blockspec"].severity,
+            )
+        ]
+    findings: List[Finding] = []
+    for site in sites:
+        for point in points:
+            findings.extend(_check_site(site, point))
+    return findings

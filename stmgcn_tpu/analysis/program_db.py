@@ -1,0 +1,324 @@
+"""Pass 0: the repo-wide program database behind whole-program lint.
+
+The per-module AST lint (:mod:`.lint`) deliberately stops at module
+boundaries: its jit-reachability seeds propagate through same-module
+calls only, so a host-sync inside a helper that *another* module's
+jitted code calls is invisible. This module builds the missing global
+view — one parse of every ``.py`` file in the package, then:
+
+- **resolved import aliases**: each module's ``import``/``from-import``
+  bindings resolved to absolute dotted targets, including relative
+  imports and re-export chains through package ``__init__`` modules
+  (``from stmgcn_tpu.ops import make_conv`` follows
+  ``ops/__init__.py``'s own ``from .chebconv import make_conv``);
+- **a global call graph over qualnames** (``module:function``) whose
+  cross-module edges exist *only* where a callee resolves statically
+  through the alias map — a ``Name`` call bound by an import, or a
+  dotted ``module.attr(...)`` call. Dynamic dispatch (``self.foo()``,
+  attributes of unknown objects) stays what it was in the per-module
+  pass: a same-module by-name edge, never a cross-module guess. That
+  asymmetry is the precision contract — whole-program mode must add
+  zero false positives on a tree the per-module pass reports clean
+  (pinned in ``tests/test_analysis.py``);
+- **global jit-reachability with call chains**: the union of every
+  module's root seeds (tracer-wrapped defs, flax ``nn.Module`` methods,
+  functions handed to ``jax.jit``/``lax.scan``/... — including *imported*
+  functions handed to a tracer, which no per-module index can seed),
+  BFS'd over the global graph with parent tracking so each newly
+  reachable function carries the root→function chain findings report.
+
+:func:`ProgramDB.module_extras` is the lint integration point: for one
+module it returns the functions that are globally jit-reachable but
+locally invisible, with their chains. :func:`ProgramDB.cross_module_gain`
+is the acceptance-criteria view (functions only the global pass sees).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from stmgcn_tpu.analysis.lint import _TRACER_WRAPPERS, _ModuleIndex
+
+__all__ = ["ModuleEntry", "ProgramDB"]
+
+#: re-export chains longer than this are a cycle, not a design
+_MAX_ALIAS_DEPTH = 8
+
+
+@dataclasses.dataclass
+class ModuleEntry:
+    """One parsed module: source, tree, per-module index, import map."""
+
+    name: str  # absolute dotted module name
+    path: str  # repo-relative posix path (what findings report)
+    source: str
+    tree: ast.Module
+    index: _ModuleIndex
+    imports: Dict[str, str]  # local binding -> absolute dotted target
+    is_package: bool  # an __init__.py
+
+
+def _module_imports(
+    tree: ast.Module, mod_name: str, is_package: bool
+) -> Dict[str, str]:
+    """Local name -> absolute dotted target, relative imports resolved."""
+    out: Dict[str, str] = {}
+    pkg_parts = mod_name.split(".") if is_package else mod_name.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    # `import a.b.c` binds only `a` — and `a` names the
+                    # top-level package, which resolve_symbol then walks
+                    out[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                if not base and node.level > 0:
+                    continue  # relative import above the package root
+                prefix = ".".join(base + ([node.module] if node.module else []))
+            else:
+                prefix = node.module or ""
+            if not prefix:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{prefix}.{a.name}"
+    return out
+
+
+class ProgramDB:
+    """Module graph + resolved aliases + global jit-reachability."""
+
+    def __init__(self, entries: Dict[str, ModuleEntry]):
+        self.modules = entries
+        self.roots: Set[str] = set()
+        self.edges: Dict[str, Set[str]] = {}
+        self._build_graph()
+        self._reach: Optional[Dict[str, Tuple[str, ...]]] = None
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_root(cls, root: str, package: Optional[str] = None) -> "ProgramDB":
+        """Parse every ``.py`` under ``root`` (a package directory)."""
+        root_path = Path(root)
+        package = package or root_path.name
+        cwd = os.getcwd()
+        entries: Dict[str, ModuleEntry] = {}
+        for f in sorted(root_path.rglob("*.py")):
+            rel_mod = f.relative_to(root_path)
+            parts = [package] + list(rel_mod.parts[:-1])
+            is_package = f.name == "__init__.py"
+            if not is_package:
+                parts.append(f.stem)
+            name = ".".join(parts)
+            rel = os.path.relpath(f, cwd)
+            rel = f.as_posix() if rel.startswith("..") else Path(rel).as_posix()
+            source = f.read_text()
+            entry = cls._entry(name, rel, source, is_package)
+            if entry is not None:
+                entries[name] = entry
+        return cls(entries)
+
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str]) -> "ProgramDB":
+        """Build from ``{dotted module name: source}`` (test fixtures)."""
+        entries: Dict[str, ModuleEntry] = {}
+        for name, src in sources.items():
+            path = name.replace(".", "/") + ".py"
+            entry = cls._entry(name, path, src, is_package=False)
+            if entry is not None:
+                entries[name] = entry
+        return cls(entries)
+
+    @staticmethod
+    def _entry(
+        name: str, path: str, source: str, is_package: bool
+    ) -> Optional[ModuleEntry]:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return None  # the per-module lint reports unparseable files
+        index = _ModuleIndex()
+        index.visit(tree)
+        return ModuleEntry(
+            name=name,
+            path=path,
+            source=source,
+            tree=tree,
+            index=index,
+            imports=_module_imports(tree, name, is_package),
+            is_package=is_package,
+        )
+
+    # -- symbol resolution -------------------------------------------------
+    def resolve_symbol(self, dotted: str, _depth: int = 0) -> Optional[str]:
+        """Absolute dotted path -> ``module:function`` qualname, following
+        re-export chains; None when it doesn't land on a known def."""
+        if _depth > _MAX_ALIAS_DEPTH:
+            return None
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod not in self.modules:
+                continue
+            rest = parts[i:]
+            if len(rest) != 1:
+                return None  # attribute chain below a symbol: dynamic
+            entry = self.modules[mod]
+            sym = rest[0]
+            if sym in entry.index.funcs:
+                return f"{mod}:{sym}"
+            if sym in entry.imports:
+                return self.resolve_symbol(entry.imports[sym], _depth + 1)
+            return None
+        return None
+
+    def _resolve_local(self, entry: ModuleEntry, dotted: str) -> Optional[str]:
+        """Resolve a dotted expression rooted at one of ``entry``'s local
+        bindings (``conv_mod.make_conv`` / imported ``make_conv``)."""
+        root, _, rest = dotted.partition(".")
+        target = entry.imports.get(root)
+        if target is None:
+            return None
+        full = f"{target}.{rest}" if rest else target
+        return self.resolve_symbol(full)
+
+    # -- the global graph --------------------------------------------------
+    def _build_graph(self) -> None:
+        # register every def first — edge targets must exist before any
+        # module's walker runs, whatever the module iteration order
+        for name, entry in self.modules.items():
+            for fn in entry.index.funcs:
+                self.edges.setdefault(f"{name}:{fn}", set())
+            for root_fn in entry.index.roots:
+                if root_fn in entry.index.funcs:
+                    self.roots.add(f"{name}:{root_fn}")
+        for entry in self.modules.values():
+            _GraphWalker(self, entry).visit(entry.tree)
+
+    def global_reachability(self) -> Dict[str, Tuple[str, ...]]:
+        """``qualname -> root→...→qualname chain`` for every globally
+        jit-reachable function (roots map to one-element chains)."""
+        if self._reach is not None:
+            return self._reach
+        parent: Dict[str, Optional[str]] = {}
+        seen: Set[str] = set()
+        frontier: List[str] = []
+        for r in sorted(self.roots):
+            if r in self.edges:  # root must be a known def
+                seen.add(r)
+                parent[r] = None
+                frontier.append(r)
+        while frontier:
+            q = frontier.pop()
+            for callee in sorted(self.edges.get(q, ())):
+                if callee not in seen:
+                    seen.add(callee)
+                    parent[callee] = q
+                    frontier.append(callee)
+        out: Dict[str, Tuple[str, ...]] = {}
+        for q in seen:
+            chain: List[str] = []
+            cur: Optional[str] = q
+            while cur is not None:
+                chain.append(cur)
+                cur = parent[cur]
+            out[q] = tuple(reversed(chain))
+        self._reach = out
+        return out
+
+    # -- lint integration views --------------------------------------------
+    def module_extras(
+        self, mod_name: str
+    ) -> Dict[str, Tuple[str, ...]]:
+        """Functions of ``mod_name`` that are globally jit-reachable but
+        invisible to the per-module pass, with their call chains."""
+        entry = self.modules[mod_name]
+        local = entry.index.reachable()
+        out: Dict[str, Tuple[str, ...]] = {}
+        for q, chain in self.global_reachability().items():
+            mod, _, fn = q.partition(":")
+            if mod == mod_name and fn not in local:
+                out[fn] = chain
+        return out
+
+    def cross_module_gain(self) -> Dict[str, Tuple[str, ...]]:
+        """Every globally-reachable qualname the per-module pass misses —
+        the acceptance-criteria view (must be non-empty on this tree)."""
+        out: Dict[str, Tuple[str, ...]] = {}
+        for mod_name in self.modules:
+            for fn, chain in self.module_extras(mod_name).items():
+                out[f"{mod_name}:{fn}"] = chain
+        return out
+
+
+class _GraphWalker(ast.NodeVisitor):
+    """Per-module sweep adding this module's edges to the global graph.
+
+    Same attribution discipline as the local index (calls belong to the
+    innermost enclosing def), but callees resolve through the import map
+    first; only unresolved names fall back to same-module by-name edges.
+    """
+
+    def __init__(self, db: ProgramDB, entry: ModuleEntry):
+        self.db = db
+        self.entry = entry
+        self._stack: List[str] = []
+
+    def _handle_func(self, node) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _handle_func
+    visit_AsyncFunctionDef = _handle_func
+
+    def _add_edge(self, callee_q: str) -> None:
+        if self._stack and callee_q in self.db.edges:
+            caller_q = f"{self.entry.name}:{self._stack[-1]}"
+            self.db.edges.setdefault(caller_q, set()).add(callee_q)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        entry = self.entry
+        target: Optional[str] = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+            if name in entry.imports:
+                target = self.db.resolve_symbol(entry.imports[name])
+            if target is None and name in entry.index.funcs:
+                target = f"{entry.name}:{name}"
+        elif isinstance(node.func, ast.Attribute):
+            dotted = entry.index.dotted(node.func)
+            if dotted:
+                target = self._resolve_dotted(dotted)
+            if target is None and node.func.attr in entry.index.funcs:
+                # self.foo() / unknown-object attr: the per-module rule
+                target = f"{entry.name}:{node.func.attr}"
+        if target is not None:
+            self._add_edge(target)
+
+        # an *imported* function handed to a tracing transform becomes a
+        # global root — the seed no per-module index can plant
+        d = entry.index.dotted(node.func)
+        if d and d.split(".")[-1] in _TRACER_WRAPPERS:
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in entry.imports:
+                        q = self.db.resolve_symbol(entry.imports[sub.id])
+                        if q is not None:
+                            self.db.roots.add(q)
+        self.generic_visit(node)
+
+    def _resolve_dotted(self, dotted: str) -> Optional[str]:
+        q = self.db.resolve_symbol(dotted)
+        if q is not None:
+            return q
+        return self.db._resolve_local(self.entry, dotted)

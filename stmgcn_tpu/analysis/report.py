@@ -16,7 +16,9 @@ from typing import Iterable, List
 __all__ = ["Finding", "render_json", "render_text"]
 
 #: bumped when the JSON report shape or rule ids change incompatibly
-REPORT_VERSION = 1
+#: (v2: whole-program lint — findings carry ``chain``/``suppressed``,
+#: counts exclude suppressed findings)
+REPORT_VERSION = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -25,6 +27,11 @@ class Finding:
 
     ``path`` is repo-relative where possible; ``line``/``col`` are
     1-based (col 0 for whole-file findings such as contract failures).
+    ``chain`` is the cross-module jit-reachability call chain
+    (``module:function`` qualnames, root first) when whole-program mode
+    promoted the enclosing function — empty for per-module findings.
+    ``suppressed`` findings survive only under ``--include-suppressed``
+    and never gate (excluded from the error/warning counts).
     """
 
     rule: str
@@ -33,12 +40,23 @@ class Finding:
     message: str
     col: int = 0
     severity: str = "error"  # "error" gates; "warning" reports only
+    chain: tuple = ()
+    suppressed: bool = False
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["chain"] = list(self.chain)
+        return d
 
     def __str__(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+        mark = " (suppressed)" if self.suppressed else ""
+        via = (
+            f" [via {' -> '.join(self.chain)}]" if len(self.chain) > 1 else ""
+        )
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+            f"{self.message}{via}{mark}"
+        )
 
 
 def render_text(findings: Iterable[Finding]) -> str:
@@ -47,19 +65,27 @@ def render_text(findings: Iterable[Finding]) -> str:
     if not ordered:
         return "stmgcn lint: clean"
     lines: List[str] = [str(f) for f in ordered]
-    n_err = sum(1 for f in ordered if f.severity == "error")
-    n_warn = len(ordered) - n_err
-    lines.append(f"stmgcn lint: {n_err} error(s), {n_warn} warning(s)")
+    live = [f for f in ordered if not f.suppressed]
+    n_err = sum(1 for f in live if f.severity == "error")
+    n_warn = len(live) - n_err
+    tail = f"stmgcn lint: {n_err} error(s), {n_warn} warning(s)"
+    n_sup = len(ordered) - len(live)
+    if n_sup:
+        tail += f", {n_sup} suppressed"
+    lines.append(tail)
     return "\n".join(lines)
 
 
 def render_json(findings: Iterable[Finding]) -> str:
-    """Machine-readable report (the CI contract)."""
+    """Machine-readable report (the CI contract). Suppressed findings
+    (present only under ``--include-suppressed``) are listed but never
+    counted — the counts are what gates."""
     ordered = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    live = [f for f in ordered if not f.suppressed]
     payload = {
         "version": REPORT_VERSION,
-        "errors": sum(1 for f in ordered if f.severity == "error"),
-        "warnings": sum(1 for f in ordered if f.severity != "error"),
+        "errors": sum(1 for f in live if f.severity == "error"),
+        "warnings": sum(1 for f in live if f.severity != "error"),
         "findings": [f.to_dict() for f in ordered],
     }
     return json.dumps(payload, indent=2)

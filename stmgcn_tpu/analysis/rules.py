@@ -68,6 +68,15 @@ _ALL_RULES = [
         "lambda/list/dict literal at a static_argnums/static_argnames "
         "position (new identity/unhashable value -> retrace or TypeError)",
     ),
+    Rule(
+        "closure-identity",
+        "warning",
+        "a per-call-fresh callable identity reaches jax.jit's trace cache "
+        "— functools.partial / a bound method / a nested def at a "
+        "static_argnums/static_argnames position, or jax.jit bound inside "
+        "a loop body — each call (or iteration) presents a new identity "
+        "and silently retraces",
+    ),
     # -- pass 2: jaxpr / sharding contracts ------------------------------
     Rule(
         "fp64-promotion",
@@ -118,6 +127,23 @@ _ALL_RULES = [
         "increasing, tops out below max_batch, or a rung's worst-case pad "
         "waste exceeds max_pad_waste) — engine construction would reject it "
         "at deploy time",
+    ),
+    Rule(
+        "pallas-blockspec",
+        "error",
+        "a pl.pallas_call BlockSpec/grid disagrees with its operand "
+        "shapes (non-divisible block dims, grid not covering the padded "
+        "rows, spec/operand arity mismatch, or the static checker out of "
+        "sync with the kernel source) — Mosaic rejects the program or "
+        "the kernel addresses rows it was never given",
+    ),
+    Rule(
+        "pallas-vmem",
+        "error",
+        "a pallas_call's estimated VMEM footprint (double-buffered "
+        "streamed blocks + resident blocks, calibrated against the real "
+        "Mosaic AOT 18.04 MB fp32-forward OOM) exceeds the ~16 MiB/core "
+        "scoped budget — Mosaic aborts compilation on a real chip",
     ),
     Rule(
         "partition-axis-name",

@@ -90,7 +90,12 @@ def _block_rows(itemsize: int, T: int, L: int) -> tuple[int, int]:
     ``STMGCN_PALLAS_FWD_ROWS`` / ``STMGCN_PALLAS_BWD_ROWS`` override the
     derived sizes (tuning knob for on-chip sweeps —
     ``benchmarks/pallas_block_sweep.py``); the fwd/bwd divisibility
-    invariant below still applies and is asserted.
+    invariant below still applies and is asserted. Any resizing here is
+    re-checked statically by ``stmgcn lint``'s Pallas pass
+    (``analysis/pallas_check.py``): it re-derives both kernels' BlockSpec
+    blocks from these row counts and gates on a VMEM-footprint estimate
+    calibrated against the real-Mosaic 18.04 MB OOM below — an override
+    that would OOM on chip fails lint on CPU first.
 
     Every VMEM-resident term scales as ``rows * T * (5 + 2L) * H``
     (``xp``+``out`` blocks plus the two ``(T, L, rows, H)`` residual

@@ -57,7 +57,10 @@ from stmgcn_tpu.analysis.program_db import ProgramDB
 from stmgcn_tpu.analysis.report import Finding, render_json, render_text
 from stmgcn_tpu.analysis.resident_check import check_resident_memory
 from stmgcn_tpu.analysis.rules import RULES, Rule
-from stmgcn_tpu.analysis.serving_check import check_serving_buckets
+from stmgcn_tpu.analysis.serving_check import (
+    check_serving_buckets,
+    check_serving_slo,
+)
 from stmgcn_tpu.analysis.sharding_check import check_partition_specs
 
 __all__ = [
@@ -71,6 +74,7 @@ __all__ = [
     "check_partition_specs",
     "check_resident_memory",
     "check_serving_buckets",
+    "check_serving_slo",
     "check_step_contracts",
     "lint_package",
     "lint_paths",

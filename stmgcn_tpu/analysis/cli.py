@@ -112,7 +112,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
         from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
         from stmgcn_tpu.analysis.resident_check import check_resident_memory
-        from stmgcn_tpu.analysis.serving_check import check_serving_buckets
+        from stmgcn_tpu.analysis.serving_check import (
+            check_serving_buckets,
+            check_serving_slo,
+        )
         from stmgcn_tpu.analysis.sharding_check import check_partition_specs
         from stmgcn_tpu.utils.platform import force_host_platform
 
@@ -122,6 +125,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_resident_memory())
         findings.extend(check_fleet_shape_classes())
         findings.extend(check_serving_buckets())
+        findings.extend(check_serving_slo())
         # static Pallas checks ride the contract section: deriving the
         # kernel's real block sizes imports ops.pallas_lstm (jax), which
         # --no-contracts' no-JAX promise must not do

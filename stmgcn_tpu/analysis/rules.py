@@ -129,6 +129,16 @@ _ALL_RULES = [
         "at deploy time",
     ),
     Rule(
+        "serving-slo",
+        "error",
+        "a preset's SLO/admission knobs are self-contradictory (deadline_ms "
+        "at or below the max_delay_ms coalescing floor sheds every "
+        "coalesced request, queue_bound_rows below the top rung can never "
+        "fill a saturated dispatch, degrade_rung outside the ladder has no "
+        "compiled program) — a deploy-time outage detectable from config "
+        "math",
+    ),
+    Rule(
         "pallas-blockspec",
         "error",
         "a pl.pallas_call BlockSpec/grid disagrees with its operand "

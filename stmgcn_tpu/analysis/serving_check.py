@@ -1,14 +1,25 @@
-"""Pass 2e: serving-bucket-shape contracts — static ladder math.
+"""Pass 2e: serving config contracts — static ladder + SLO math.
 
 The serving engine compiles one AOT program per ``ServingConfig.buckets``
-rung and pads every request batch up to its covering rung. A bad ladder
-fails only at engine construction — i.e. at deploy time, on the serving
-host. This pass re-derives the ladder contract from the config alone
-(the same :meth:`~stmgcn_tpu.config.ServingConfig.violations` math the
-engine enforces) and flags it at lint time instead: rungs must be
-strictly increasing, the top rung must cover ``max_batch`` (batches
-above it have no program), and no rung's worst-case padded waste — a
-batch one row past the previous rung — may exceed ``max_pad_waste``.
+rung and pads every request batch up to its covering rung; with the SLO
+knobs set it also builds an admission controller in front of the queue.
+A bad ladder or a self-contradictory SLO fails only at engine
+construction — i.e. at deploy time, on the serving host. These passes
+re-derive both contracts from the config alone (the same
+:meth:`~stmgcn_tpu.config.ServingConfig.violations` math the engine
+enforces) and flag them at lint time instead:
+
+- ``serving-bucket-shape`` (:func:`check_serving_buckets`): rungs must
+  be strictly increasing, the top rung must cover ``max_batch`` (batches
+  above it have no program), and no rung's worst-case padded waste — a
+  batch one row past the previous rung — may exceed ``max_pad_waste``.
+- ``serving-slo`` (:func:`check_serving_slo`): ``deadline_ms`` must
+  exceed the coalescing delay floor ``max_delay_ms`` (below it every
+  coalesced request is shed by construction), ``queue_bound_rows`` must
+  cover the top rung (a tighter bound can never fill a saturated
+  dispatch), and ``degrade_rung`` must be a ladder rung under the
+  "degrade" policy (no compiled program exists for anything else).
+
 Pure config math, safe without a JAX backend.
 """
 
@@ -19,7 +30,32 @@ from typing import Iterable, List, Optional, Tuple
 from stmgcn_tpu.analysis.report import Finding
 from stmgcn_tpu.analysis.rules import RULES
 
-__all__ = ["check_serving_buckets"]
+__all__ = ["check_serving_buckets", "check_serving_slo"]
+
+
+def _preset_configs():
+    from stmgcn_tpu.config import PRESETS
+
+    return [(name, build()) for name, build in PRESETS.items()]
+
+
+def _check_configs(configs, rule: str, method: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, cfg in configs:
+        serving = getattr(cfg, "serving", None)
+        if serving is None:
+            continue
+        for message in getattr(serving, method)():
+            findings.append(
+                Finding(
+                    rule=rule,
+                    path=f"<contract:serving:{name}>",
+                    line=0,
+                    message=f"{name}: {message}",
+                    severity=RULES[rule].severity,
+                )
+            )
+    return findings
 
 
 def check_serving_buckets(
@@ -30,24 +66,19 @@ def check_serving_buckets(
     ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
     registered preset.
     """
-    from stmgcn_tpu.config import PRESETS
-
     if configs is None:
-        configs = [(name, build()) for name, build in PRESETS.items()]
+        configs = _preset_configs()
+    return _check_configs(configs, "serving-bucket-shape", "ladder_violations")
 
-    findings: List[Finding] = []
-    for name, cfg in configs:
-        serving = getattr(cfg, "serving", None)
-        if serving is None:
-            continue
-        for message in serving.violations():
-            findings.append(
-                Finding(
-                    rule="serving-bucket-shape",
-                    path=f"<contract:serving:{name}>",
-                    line=0,
-                    message=f"{name}: {message}",
-                    severity=RULES["serving-bucket-shape"].severity,
-                )
-            )
-    return findings
+
+def check_serving_slo(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+) -> List[Finding]:
+    """Validate every preset's SLO / admission-control knobs.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset.
+    """
+    if configs is None:
+        configs = _preset_configs()
+    return _check_configs(configs, "serving-slo", "slo_violations")

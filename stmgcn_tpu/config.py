@@ -274,9 +274,10 @@ class ServingConfig:
     micro-batcher coalesces concurrent requests into the smallest
     covering rung, waiting at most ``max_delay_ms`` for co-riders.
     ``violations()`` is the ladder's static contract — pure config math,
-    shared by engine construction and the ``serving-bucket-shape``
-    analysis rule, so a bad ladder fails ``stmgcn lint`` before it fails
-    a deployment.
+    shared by engine construction and the ``serving-bucket-shape`` /
+    ``serving-slo`` analysis rules, so a bad ladder or a
+    self-contradictory SLO fails ``stmgcn lint`` before it fails a
+    deployment.
     """
 
     #: ascending batch-size ladder; one compiled program per rung. Keep 1
@@ -291,6 +292,29 @@ class ServingConfig:
     #: ``p`` pads to the next rung ``b`` wasting ``(b - p - 1) / b`` —
     #: ladders with bigger gaps than this fail validation
     max_pad_waste: float = 0.75
+    #: per-request SLO deadline (ms from submit to response). None (the
+    #: default) disables admission control entirely — unbounded queue,
+    #: never shed, the pre-SLO engine behavior. When set, the admission
+    #: controller rejects requests whose estimated wait (queue depth x
+    #: measured per-rung device time) already exceeds the deadline, and
+    #: the batcher sheds queued requests whose deadline expired before
+    #: dispatch. Must exceed ``max_delay_ms``: a deadline below the
+    #: coalescing delay rejects every coalesced request by construction.
+    deadline_ms: Optional[float] = None
+    #: bounded-queue admission limit (pending ROWS, not requests); 0 = no
+    #: bound. Arrivals past the bound are rejected ``Overloaded``. Must
+    #: cover the top rung — a bound below it could never fill a
+    #: saturated dispatch.
+    queue_bound_rows: int = 0
+    #: what an over-SLO arrival gets: "reject" raises the typed
+    #: Overloaded/DeadlineExceeded; "degrade" first tries to serve it
+    #: inline through ``predict_direct`` at ``degrade_rung`` (bypassing
+    #: the queue — bounded work, no coalescing), rejecting only requests
+    #: too big for that rung
+    shed_policy: str = "reject"
+    #: ladder rung used by the "degrade" policy; None = the smallest
+    #: rung. Must be a member of ``buckets``.
+    degrade_rung: Optional[int] = None
 
     def __post_init__(self):
         # json round-trips hand lists back; the to_dict/from_dict identity
@@ -298,7 +322,14 @@ class ServingConfig:
         self.buckets = tuple(int(b) for b in self.buckets)
 
     def violations(self) -> list:
-        """Every way this ladder is unservable (empty list = valid)."""
+        """Every way this config is unservable (empty list = valid):
+        the ladder contract plus the SLO contract. Engine construction
+        rejects on any; lint splits them across ``serving-bucket-shape``
+        and ``serving-slo``."""
+        return self.ladder_violations() + self.slo_violations()
+
+    def ladder_violations(self) -> list:
+        """Bucket-ladder shape violations (the serving-bucket-shape rule)."""
         v = []
         b = self.buckets
         if not b:
@@ -335,6 +366,50 @@ class ServingConfig:
                 prev = cur
         if self.max_delay_ms < 0:
             v.append(f"max_delay_ms must be >= 0, got {self.max_delay_ms}")
+        return v
+
+    def slo_violations(self) -> list:
+        """Self-contradictory SLO / admission knobs (the serving-slo rule).
+
+        These configs *construct* an admission controller that can never
+        behave as intended — every coalesced request shed, a queue that
+        cannot fill a dispatch, a degrade rung with no program — so they
+        are deploy-time outages detectable from pure config math.
+        """
+        v = []
+        b = self.buckets
+        if self.deadline_ms is not None and self.deadline_ms <= self.max_delay_ms:
+            v.append(
+                f"deadline_ms {self.deadline_ms} must exceed max_delay_ms "
+                f"{self.max_delay_ms} — a request may legitimately wait the "
+                "full coalescing delay, so a tighter deadline sheds every "
+                "coalesced request by construction"
+            )
+        if self.queue_bound_rows < 0:
+            v.append(
+                f"queue_bound_rows must be >= 0, got {self.queue_bound_rows}"
+            )
+        elif self.queue_bound_rows and b and self.queue_bound_rows < b[-1]:
+            v.append(
+                f"queue_bound_rows {self.queue_bound_rows} is below the top "
+                f"rung {b[-1]} — a saturated dispatch could never fill"
+            )
+        if self.shed_policy not in ("reject", "degrade"):
+            v.append(
+                f"shed_policy must be 'reject' or 'degrade', got "
+                f"{self.shed_policy!r}"
+            )
+        if self.degrade_rung is not None:
+            if self.shed_policy != "degrade":
+                v.append(
+                    f"degrade_rung {self.degrade_rung} is set but shed_policy "
+                    f"is {self.shed_policy!r} — the rung would never be used"
+                )
+            if self.degrade_rung not in b:
+                v.append(
+                    f"degrade_rung {self.degrade_rung} is not a ladder rung "
+                    f"{b} — no compiled program exists for it"
+                )
         return v
 
 

@@ -141,27 +141,33 @@ class Forecaster:
             normalized,
         )
 
-    def serving_engine(self, supports, *, config=None, city=None):
+    def serving_engine(self, supports, *, config=None, city=None,
+                       fault_plan=None):
         """A :class:`stmgcn_tpu.serving.ServingEngine` over this checkpoint:
-        per-bucket AOT programs (no per-call jit dispatch), params and
-        ``supports`` pinned device-resident, concurrent ``predict`` calls
-        micro-batched. Results are bit-identical to :meth:`predict`."""
+        per-bucket AOT programs (no per-call jit dispatch), ``supports``
+        pinned device-resident, params hot-swappable, concurrent
+        ``predict`` calls micro-batched behind SLO admission control.
+        Results are bit-identical to :meth:`predict`. ``fault_plan``
+        threads a :class:`stmgcn_tpu.resilience.ServeFaultPlan` through
+        (deterministic overload/fault tests; empty plan is a no-op)."""
         from stmgcn_tpu.serving import ServingEngine
 
         return ServingEngine.from_forecaster(
-            self, supports, config=config, city=city
+            self, supports, config=config, city=city, fault_plan=fault_plan
         )
 
     def fleet_engine(self, city_supports, *, config=None,
-                     max_classes: int = 8, max_pad_waste: float = 0.5):
+                     max_classes: int = 8, max_pad_waste: float = 0.5,
+                     fault_plan=None):
         """A :class:`stmgcn_tpu.serving.FleetServingEngine` over this
         heterogeneous checkpoint: every city served from one engine,
         requests for different cities of a shape class coalescing into
-        one dispatch. Results are bit-identical to per-city
-        :meth:`predict`."""
+        one dispatch, params hot-swappable fleet-wide. Results are
+        bit-identical to per-city :meth:`predict`."""
         from stmgcn_tpu.serving import FleetServingEngine
 
         return FleetServingEngine.from_forecaster(
             self, city_supports, config=config,
             max_classes=max_classes, max_pad_waste=max_pad_waste,
+            fault_plan=fault_plan,
         )

@@ -18,24 +18,35 @@ tested paths:
 - :class:`Preempted` — raised at a safe step boundary after SIGTERM once
   the emergency checkpoint has landed; a ``BaseException`` so broad
   ``except Exception`` recovery code cannot swallow a shutdown request.
+- :class:`ServeFaultPlan` / :class:`ServeFaultSpec` — the serving-side
+  mirror: dispatch-addressed raise/slow/hang faults, batcher-thread
+  death (:class:`BatcherKilled`), and at-rest checkpoint corruption for
+  the hot-swap watcher, so every shed/degrade/swap path of the serving
+  engine is exercised deterministically too.
 
 The verified-checkpoint side (CRC32 format v2, ``load_latest_verified``
 recovery chain) lives in :mod:`stmgcn_tpu.train.checkpoint`.
 """
 
 from stmgcn_tpu.resilience.faults import (
+    BatcherKilled,
     FaultPlan,
     FaultSpec,
     InjectedFault,
     Preempted,
+    ServeFaultPlan,
+    ServeFaultSpec,
 )
 from stmgcn_tpu.resilience.guard import DivergenceError, DivergenceGuard
 
 __all__ = [
+    "BatcherKilled",
     "DivergenceError",
     "DivergenceGuard",
     "FaultPlan",
     "FaultSpec",
     "InjectedFault",
     "Preempted",
+    "ServeFaultPlan",
+    "ServeFaultSpec",
 ]

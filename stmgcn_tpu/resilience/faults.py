@@ -21,6 +21,14 @@ Write faults address checkpoint writes by filename glob + ordinal among
 the matching writes, and corrupt the serialized bytes *before* they
 reach the atomic writer — simulating disk-level truncation/bit rot of a
 file that did land, the case ``os.replace`` atomicity cannot cover.
+
+The serving side gets the same treatment (:class:`ServeFaultPlan` /
+:class:`ServeFaultSpec`): faults address the micro-batcher's *dispatch
+ordinal* (0-based count of coalesced dispatches) instead of training
+steps, plus an at-rest checkpoint corruption hook the hot-swap watcher
+consults — so every shed/degrade/swap path in the serving engine is
+exercised deterministically, and the empty plan is again a production
+no-op.
 """
 
 from __future__ import annotations
@@ -31,11 +39,27 @@ import os
 import signal
 from typing import Optional, Tuple
 
-__all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "Preempted"]
+__all__ = [
+    "BatcherKilled",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "Preempted",
+    "SERVE_KINDS",
+    "ServeFaultPlan",
+    "ServeFaultSpec",
+]
 
 _STEP_KINDS = ("raise", "sigterm", "poison", "drop")
 _WRITE_KINDS = ("truncate-write", "corrupt-write")
 KINDS = _STEP_KINDS + _WRITE_KINDS
+SERVE_KINDS = (
+    "dispatch-raise",
+    "dispatch-slow",
+    "dispatch-hang",
+    "batcher-die",
+    "corrupt-checkpoint",
+)
 
 
 class InjectedFault(RuntimeError):
@@ -50,6 +74,17 @@ class Preempted(BaseException):
     ``except Exception`` retry/recovery code must not swallow a shutdown
     request — the process has been asked to die and should exit after
     unwinding. ``--resume auto`` continues the run bit-exactly.
+    """
+
+
+class BatcherKilled(BaseException):
+    """Raised by a ``kind="batcher-die"`` serve fault at dispatch entry.
+
+    Deliberately a ``BaseException``: the micro-batcher's dispatch error
+    handling catches ``Exception`` (a dying *dispatch* releases its
+    waiters and the worker lives on), so this escapes that handler and
+    kills the worker thread itself — the wedged-batcher scenario the
+    engine's degrade-to-direct fallback exists for.
     """
 
 
@@ -204,3 +239,154 @@ class FaultPlan:
                 mutated[idx] ^= 0x01
                 data = bytes(mutated)
         return data
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeFaultSpec:
+    """One deterministic serving-side trigger in a :class:`ServeFaultPlan`.
+
+    Dispatch kinds (addressed by ``dispatch``, the 0-based ordinal of
+    coalesced micro-batch dispatches; ``None`` = every dispatch):
+
+    - ``"dispatch-raise"`` — raise :class:`InjectedFault` at dispatch
+      entry (one-shot): the XLA-error/driver-crash stand-in. The batcher
+      must wrap it per waiter and the worker must survive.
+    - ``"dispatch-slow"``  — sleep ``slow_ms`` before the dispatch (pure
+      match): sustained device slowdown, the regime that backs the queue
+      up and makes admission control shed.
+    - ``"dispatch-hang"``  — sleep ``hang_ms`` before the dispatch (pure
+      match): a long stall; queued requests' deadlines expire behind it
+      and must be shed at dispatch time, not served late.
+    - ``"batcher-die"``    — raise :class:`BatcherKilled` at dispatch
+      entry (one-shot): kills the worker thread itself; pending and
+      future submits must fail fast (``BatcherWedged``) and the engine
+      must degrade to its inline path.
+
+    Checkpoint kind (addressed by ``path_glob``):
+
+    - ``"corrupt-checkpoint"`` — flip one bit of byte ``flip_byte`` of a
+      matching checkpoint file *at rest* (one-shot per spec), before the
+      hot-swap watcher reads it: the mid-watch bit-rot drill. The
+      watcher must quarantine and keep serving the old params.
+    """
+
+    kind: str
+    dispatch: Optional[int] = None
+    slow_ms: float = 0.0
+    hang_ms: float = 0.0
+    path_glob: str = "latest.ckpt"
+    flip_byte: int = -1
+
+    def __post_init__(self):
+        if self.kind not in SERVE_KINDS:
+            raise ValueError(
+                f"serve fault kind must be one of {SERVE_KINDS}, got "
+                f"{self.kind!r}"
+            )
+        if self.kind == "dispatch-slow" and self.slow_ms <= 0:
+            raise ValueError("dispatch-slow faults need slow_ms > 0")
+        if self.kind == "dispatch-hang" and self.hang_ms <= 0:
+            raise ValueError("dispatch-hang faults need hang_ms > 0")
+        if self.kind in ("dispatch-raise", "batcher-die") and self.dispatch is None:
+            raise ValueError(
+                f"{self.kind!r} faults need an explicit dispatch ordinal"
+            )
+
+    def _matches_dispatch(self, ordinal: int) -> bool:
+        return self.dispatch is None or self.dispatch == ordinal
+
+
+class ServeFaultPlan:
+    """Deterministic serving faults, consulted by the micro-batch worker
+    at dispatch entry and by the hot-swap watcher before each poll.
+
+    Same contract as :class:`FaultPlan`: the empty plan is the
+    production default and every hook short-circuits immediately — the
+    engine has no instrumented build. One-shot state lives on the plan
+    instance.
+    """
+
+    def __init__(self, *specs: ServeFaultSpec):
+        if len(specs) == 1 and not isinstance(specs[0], ServeFaultSpec):
+            specs = tuple(specs[0])  # accept ServeFaultPlan([spec, ...])
+        for s in specs:
+            if not isinstance(s, ServeFaultSpec):
+                raise TypeError(
+                    f"ServeFaultPlan takes ServeFaultSpecs, got "
+                    f"{type(s).__name__}"
+                )
+        self.specs: Tuple[ServeFaultSpec, ...] = tuple(specs)
+        self._fired: set = set()
+
+    @property
+    def active(self) -> bool:
+        return bool(self.specs)
+
+    def before_dispatch(self, ordinal: int) -> None:
+        """Fire any fault addressed to this dispatch ordinal. Sleeps for
+        slow/hang kinds; raises for raise/die kinds (one-shot)."""
+        if not self.specs:
+            return
+        import time
+
+        for i, spec in enumerate(self.specs):
+            if not spec._matches_dispatch(ordinal):
+                continue
+            if spec.kind == "dispatch-slow":
+                time.sleep(spec.slow_ms / 1e3)
+            elif spec.kind == "dispatch-hang":
+                time.sleep(spec.hang_ms / 1e3)
+            elif spec.kind in ("dispatch-raise", "batcher-die"):
+                key = ("dispatch", i)
+                if key in self._fired:
+                    continue
+                self._fired.add(key)
+                if spec.kind == "batcher-die":
+                    raise BatcherKilled(
+                        f"injected batcher death at dispatch {ordinal}"
+                    )
+                raise InjectedFault(
+                    f"injected dispatch fault at dispatch {ordinal}"
+                )
+
+    def corrupt_checkpoints(self, out_dir: str) -> list:
+        """Flip bytes at rest in checkpoint files matching any one-shot
+        ``corrupt-checkpoint`` spec; returns the corrupted paths. Called
+        by the hot-swap watcher at poll start, BEFORE verification — the
+        drill is bit rot landing between writer and reader."""
+        if not self.specs:
+            return []
+        hit = []
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "corrupt-checkpoint":
+                continue
+            key = ("ckpt", i)
+            if key in self._fired:
+                continue
+            try:
+                names = sorted(os.listdir(out_dir))
+            except OSError:
+                continue
+            for name in names:
+                if not fnmatch.fnmatch(name, spec.path_glob):
+                    continue
+                path = os.path.join(out_dir, name)
+                try:
+                    with open(path, "rb") as f:
+                        data = bytearray(f.read())
+                    if not data:
+                        continue
+                    idx = (
+                        spec.flip_byte
+                        if spec.flip_byte >= 0
+                        else len(data) // 2
+                    )
+                    data[idx] ^= 0x01
+                    with open(path, "wb") as f:
+                        f.write(bytes(data))
+                except OSError:
+                    continue
+                self._fired.add(key)
+                hit.append(path)
+                break
+        return hit

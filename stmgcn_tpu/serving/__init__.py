@@ -6,9 +6,15 @@ Layout:
   denormalize flow shared by ``Forecaster``, ``ExportedForecaster`` and
   the engine (one implementation, so raw-units contracts cannot drift);
 - :mod:`.bucketing` — shape-bucket arithmetic (covering rung, padding);
+- :mod:`.admission` — :class:`AdmissionController` and the typed
+  overload errors (``Overloaded``/``DeadlineExceeded`` sheds,
+  ``DispatchError``, ``BatcherWedged``): SLO admission in front of the
+  queue, so overload degrades operably instead of into unbounded p99;
 - :mod:`.engine` — :class:`ServingEngine`: per-rung AOT programs with
-  device-resident supports/params, built from a live forecaster or an
-  export artifact;
+  device-resident supports, hot-swappable params behind one atomic
+  ``(generation, params)`` reference (``swap_params`` /
+  ``watch_checkpoints``), built from a live forecaster or an export
+  artifact;
 - :mod:`.fleet` — :class:`FleetServingEngine`: a ``(city -> shape
   class)`` routing layer over per-class programs + micro-batchers, so
   one engine serves a whole heterogeneous fleet from one checkpoint and
@@ -23,18 +29,37 @@ Layout:
   ``stmgcn_tpu.export`` — no flax, no models at import time).
 """
 
+from stmgcn_tpu.serving.admission import (
+    AdmissionController,
+    BatcherWedged,
+    DeadlineExceeded,
+    DispatchError,
+    Overloaded,
+    ShedError,
+)
 from stmgcn_tpu.serving.bucketing import pad_to_bucket, smallest_covering_bucket
-from stmgcn_tpu.serving.engine import ServingEngine, serve_bucket_fn
+from stmgcn_tpu.serving.engine import (
+    CheckpointWatcher,
+    ServingEngine,
+    serve_bucket_fn,
+)
 from stmgcn_tpu.serving.fleet import FleetServingEngine, fleet_bucket_fn
 from stmgcn_tpu.serving.metrics import EngineStats
 from stmgcn_tpu.serving.microbatch import MicroBatcher
 from stmgcn_tpu.serving.predict import serve_predict
 
 __all__ = [
+    "AdmissionController",
+    "BatcherWedged",
+    "CheckpointWatcher",
+    "DeadlineExceeded",
+    "DispatchError",
     "EngineStats",
     "FleetServingEngine",
     "MicroBatcher",
+    "Overloaded",
     "ServingEngine",
+    "ShedError",
     "fleet_bucket_fn",
     "pad_to_bucket",
     "serve_bucket_fn",

@@ -1,0 +1,139 @@
+"""SLO admission control: typed load shedding in front of the batcher.
+
+Under overload an unbounded micro-batch queue converts excess arrival
+rate into unbounded p99 — every request is eventually served, all of
+them late. The operable behavior is the opposite: decide *at arrival*
+whether a request can plausibly meet its deadline, and shed it with a
+typed error if not, so admitted requests keep their latency and callers
+get an actionable signal (retry elsewhere / back off) instead of a
+timeout.
+
+:class:`AdmissionController` fronts :class:`~stmgcn_tpu.serving
+.microbatch.MicroBatcher` with two tests, both O(1) under the queue
+lock:
+
+- **bounded queue** — more than ``queue_bound_rows`` pending rows
+  rejects with :class:`Overloaded` (the queue-depth circuit breaker);
+- **estimated wait** — pending dispatches ahead x the measured per-rung
+  device time (:meth:`~stmgcn_tpu.serving.metrics.EngineStats
+  .device_ms_estimate`) already past ``deadline_ms`` rejects with
+  :class:`DeadlineExceeded` — the request would miss its SLO even if
+  everything goes right, so device time is not spent on it.
+
+Admitted requests carry their deadline into the queue; the batcher sheds
+any whose deadline expires *before dispatch* (same typed error), so a
+stalled device never burns a dispatch on rows nobody is waiting for.
+
+Both knobs live on :class:`~stmgcn_tpu.config.ServingConfig`
+(``deadline_ms`` / ``queue_bound_rows`` / ``shed_policy`` /
+``degrade_rung``) and are validated by ``violations()`` + the
+``serving-slo`` lint rule. The no-SLO config (all defaults) builds no
+controller at all — the engine behaves exactly as before this layer
+existed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "AdmissionController",
+    "BatcherWedged",
+    "DeadlineExceeded",
+    "DispatchError",
+    "Overloaded",
+    "ShedError",
+]
+
+
+class ShedError(RuntimeError):
+    """Base of the typed admission rejections — a request the engine
+    chose not to serve (never a half-served one). Catch this to treat
+    both shed kinds uniformly (e.g. retry against another replica)."""
+
+
+class Overloaded(ShedError):
+    """Rejected at arrival: the pending queue is over its row bound."""
+
+
+class DeadlineExceeded(ShedError):
+    """Rejected because the deadline cannot (estimated wait at arrival)
+    or did not (expiry while queued) leave room to serve the request."""
+
+
+class DispatchError(RuntimeError):
+    """A coalesced dispatch died; every waiter of that batch receives its
+    own instance carrying the batch context (``bucket``, ``rows``,
+    ``requests``) with the device error as ``__cause__``."""
+
+    def __init__(self, message: str, *, bucket: Optional[int] = None,
+                 rows: Optional[int] = None, requests: Optional[int] = None):
+        super().__init__(message)
+        self.bucket = bucket
+        self.rows = rows
+        self.requests = requests
+
+
+class BatcherWedged(RuntimeError):
+    """The micro-batch worker thread is dead (injected fault, interpreter
+    shutdown, or a BaseException escaping a dispatch). Queued and future
+    ``submit`` calls fail fast with this instead of blocking forever; the
+    engine degrades to the inline ``predict_direct`` path on seeing it."""
+
+
+class AdmissionController:
+    """Arrival-time admission decisions for one micro-batch queue.
+
+    Stateless beyond its config + a telemetry handle: the queue depth is
+    passed in by the batcher (which owns the lock), and the per-dispatch
+    device-time estimate comes from the live :class:`EngineStats` the
+    same engine records into — the wait model tracks the actual host.
+    """
+
+    def __init__(self, config, stats, buckets):
+        self.deadline_ms: Optional[float] = config.deadline_ms
+        self.queue_bound_rows: int = int(config.queue_bound_rows)
+        self._stats = stats
+        self._top = max(buckets)
+        #: conservative floor used until the first dispatch is measured:
+        #: the coalescing delay itself (a dispatch can never be estimated
+        #: faster than the wait the batcher deliberately adds)
+        self._floor_ms = float(config.max_delay_ms)
+
+    @property
+    def deadline_s(self) -> Optional[float]:
+        return None if self.deadline_ms is None else self.deadline_ms / 1e3
+
+    def estimated_wait_ms(self, pending_rows: int) -> float:
+        """Expected queue wait for an arrival behind ``pending_rows``:
+        full dispatches ahead of it x the measured per-rung device time
+        (top rung — saturated dispatches are what a backlog drains as).
+        """
+        dispatches_ahead = pending_rows // self._top
+        per_dispatch = self._stats.device_ms_estimate(
+            self._top, default=self._floor_ms
+        )
+        return dispatches_ahead * per_dispatch
+
+    def admit(self, n_rows: int, pending_rows: int) -> None:
+        """Raise the typed rejection for an arrival of ``n_rows`` behind
+        ``pending_rows`` queued rows; return silently when admitted.
+        Called by the batcher under its queue lock."""
+        if (
+            self.queue_bound_rows
+            and pending_rows + n_rows > self.queue_bound_rows
+        ):
+            self._stats.record_shed("overloaded")
+            raise Overloaded(
+                f"queue holds {pending_rows} rows, bound is "
+                f"{self.queue_bound_rows} — request of {n_rows} rows shed"
+            )
+        if self.deadline_ms is not None:
+            est = self.estimated_wait_ms(pending_rows)
+            if est > self.deadline_ms:
+                self._stats.record_shed("deadline")
+                raise DeadlineExceeded(
+                    f"estimated queue wait {est:.1f} ms exceeds the "
+                    f"{self.deadline_ms} ms deadline at arrival — shed "
+                    "instead of serving late"
+                )

@@ -10,17 +10,29 @@ them for training:
   lowers and compiles one program per ladder rung (``ServingConfig
   .buckets``), so serving never traces, never recompiles, and never pays
   jit dispatch: a request is one ``Compiled.__call__``.
-- **device-resident operands** — the support stack (and, for the live
-  path, the parameters) are placed on device once; the history window is
-  the only per-request upload.
+- **device-resident operands** — the support stack is placed on device
+  once; parameters are an explicit program argument held behind one
+  atomic ``(generation, params)`` reference, so the history window is
+  the only per-request upload *and* a new checkpoint hot-swaps in
+  between dispatches without an AOT rebuild (:meth:`ServingEngine
+  .swap_params`, :meth:`ServingEngine.watch_checkpoints`). Every
+  response can report the generation that produced it
+  (``predict(..., with_generation=True)``) and is never mixed-generation
+  — a dispatch reads the reference once.
 - **dynamic micro-batching** — concurrent callers coalesce into the
   smallest covering rung (:mod:`stmgcn_tpu.serving.microbatch`), with
   per-bucket latency/queue/pad-waste telemetry
   (:mod:`stmgcn_tpu.serving.metrics`).
+- **SLO admission + typed sheds** — with ``ServingConfig.deadline_ms`` /
+  ``queue_bound_rows`` set, overload sheds at arrival with typed errors
+  (:mod:`stmgcn_tpu.serving.admission`); ``shed_policy="degrade"``
+  serves shed requests inline at a smaller rung instead, and a wedged
+  batcher degrades ``predict`` to the inline path automatically.
 
 Both predictor flavors feed the same engine: ``from_forecaster`` bakes a
 live checkpoint's dense serving clone, ``from_artifact`` specializes an
-exported StableHLO module's symbolic batch to each rung. Import-leanness
+exported StableHLO module's symbolic batch to each rung (that flavor
+bakes params into the module, so it cannot hot-swap). Import-leanness
 contract: this module may import jax/numpy only at module scope — the
 model stack (flax, stmgcn_tpu.models) loads lazily inside
 ``from_forecaster`` so ``import stmgcn_tpu.export`` stays lean
@@ -29,15 +41,29 @@ model stack (flax, stmgcn_tpu.models) loads lazily inside
 
 from __future__ import annotations
 
+import os
+import threading
+from typing import Optional
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from stmgcn_tpu.serving.admission import (
+    AdmissionController,
+    BatcherWedged,
+    ShedError,
+)
 from stmgcn_tpu.serving.metrics import EngineStats
 from stmgcn_tpu.serving.microbatch import MicroBatcher
 
-__all__ = ["ServingEngine", "serve_bucket_fn"]
+__all__ = ["CheckpointWatcher", "ServingEngine", "serve_bucket_fn"]
+
+#: bound on the re-dispatch loop that keeps multi-chunk responses on one
+#: param generation — hit only under pathological swap churn (a swap per
+#: dispatch, twenty dispatches in a row)
+_SWAP_RETRIES = 20
 
 
 def serve_bucket_fn(model):
@@ -46,13 +72,137 @@ def serve_bucket_fn(model):
     The one function the live-path engine compiles per ladder rung — and
     the program the jaxpr contract pass traces as ``serve_bucket``, so a
     fusion regression in the serving forward fails ``stmgcn lint`` the
-    same way a train-step regression does.
+    same way a train-step regression does. Params stay an explicit
+    argument of the compiled program (never closure-captured) — that is
+    what makes :meth:`ServingEngine.swap_params` possible without
+    recompiling the ladder.
     """
 
     def serve_bucket(params, supports, history):
         return model.apply(params, supports, history)
 
     return serve_bucket
+
+
+def _check_swap_structure(cur_dev, new_dev) -> None:
+    """The compiled ladder is shape-specialized: a hot-swap must present
+    the exact same pytree structure and leaf shapes/dtypes, else the
+    program would crash (or silently reinterpret bytes) mid-serve."""
+    cur_leaves, cur_def = jax.tree_util.tree_flatten(cur_dev)
+    new_leaves, new_def = jax.tree_util.tree_flatten(new_dev)
+    if cur_def != new_def:
+        raise ValueError(
+            "swap_params: new params have a different pytree structure "
+            "than the compiled programs were built for"
+        )
+    for a, b in zip(cur_leaves, new_leaves):
+        if a.shape != b.shape or a.dtype != b.dtype:
+            raise ValueError(
+                f"swap_params: leaf mismatch — compiled for "
+                f"{a.shape}/{a.dtype}, got {b.shape}/{b.dtype}"
+            )
+
+
+class CheckpointWatcher:
+    """Hot-swap poller: newest verified checkpoint → ``engine.swap_params``.
+
+    Watches ``out_dir`` by mtime and only ever moves *forward*: a new
+    checkpoint that fails verification is quarantined by
+    ``load_latest_verified`` and counted in :attr:`rejected` — the
+    engine keeps serving its current params rather than falling back to
+    a checkpoint older than the one already live. ``poll()`` is the
+    synchronous single-step (what tests drive deterministically); a
+    background thread calls it every ``poll_s`` seconds when one was
+    requested. The engine's :class:`~stmgcn_tpu.resilience
+    .ServeFaultPlan` gets its ``corrupt-checkpoint`` shot in *before*
+    each scan, so the corruption path is exercised end-to-end.
+    """
+
+    def __init__(self, engine, out_dir: str, poll_s: Optional[float] = None,
+                 log=None):
+        self._engine = engine
+        self.out_dir = out_dir
+        self.swaps = 0
+        self.rejected = 0
+        self.last_path: Optional[str] = None
+        self._log = log if log is not None else (lambda msg: None)
+        # start from the present: the engine was just built from the
+        # newest checkpoint, so only *future* writes should swap
+        self._seen_mtime = self._newest_mtime() or -1.0
+        self._applied_mtime = self._seen_mtime
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if poll_s is not None:
+            self._thread = threading.Thread(
+                target=self._loop, args=(float(poll_s),),
+                name="stmgcn-ckpt-watch", daemon=True,
+            )
+            self._thread.start()
+
+    def _newest_mtime(self) -> Optional[float]:
+        try:
+            names = os.listdir(self.out_dir)
+        except OSError:
+            return None
+        mtimes = []
+        for name in names:
+            if not name.endswith(".ckpt"):
+                continue
+            try:
+                mtimes.append(os.path.getmtime(os.path.join(self.out_dir, name)))
+            except OSError:
+                continue  # rotated away between listdir and stat
+        return max(mtimes) if mtimes else None
+
+    def _loop(self, poll_s: float) -> None:
+        while not self._stop.wait(poll_s):
+            try:
+                self.poll()
+            except Exception as e:  # keep watching: one bad scan (transient
+                # IO, partial write) must not end hot-swapping forever
+                self._log(f"checkpoint watch: {type(e).__name__}: {e}")
+
+    def poll(self) -> bool:
+        """One scan; returns True when a swap was applied."""
+        from stmgcn_tpu.train.checkpoint import load_latest_verified
+
+        eng = self._engine
+        plan = getattr(eng, "_fault_plan", None)
+        if plan is not None:
+            for p in plan.corrupt_checkpoints(self.out_dir):
+                self._log(f"fault plan corrupted {p}")
+        newest = self._newest_mtime()
+        if newest is None or newest <= self._seen_mtime:
+            return False
+        self._seen_mtime = newest
+        got = load_latest_verified(
+            self.out_dir, eng._params_template, None,
+            load_opt_state=False, quarantine=True, log=self._log,
+        )
+        if got is None:
+            self.rejected += 1
+            return False
+        path, _meta, params, _ = got
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = newest
+        if mtime <= self._applied_mtime:
+            # the newest file failed verification and the chain fell back
+            # to something no newer than what is already serving
+            self.rejected += 1
+            return False
+        eng.swap_params(params)
+        self.swaps += 1
+        self.last_path = path
+        self._applied_mtime = mtime
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
 
 
 class ServingEngine:
@@ -64,6 +214,9 @@ class ServingEngine:
         engine = ServingEngine.from_forecaster(fc, supports)
         pred = engine.predict(history)          # micro-batched, raw units
         pred = engine.predict_direct(history)   # bypass the queue
+        pred, gen = engine.predict(history, with_generation=True)
+        engine.swap_params(new_params)          # atomic, no AOT rebuild
+        watcher = engine.watch_checkpoints(out_dir, poll_s=2.0)
         engine.stats.snapshot()                 # per-bucket telemetry
         engine.close()
 
@@ -71,11 +224,14 @@ class ServingEngine:
     denormalize contract (normalization vectorized once per coalesced
     dispatch), so results are bit-identical to ``Forecaster.predict`` at
     any request size (padding parity pinned in tests/test_serving.py).
+    Under an SLO config it raises the typed sheds of
+    :mod:`stmgcn_tpu.serving.admission` (or serves degraded inline when
+    ``shed_policy="degrade"``).
     """
 
     def __init__(self, programs, sup_dev, supports_np, normalizer, expected,
-                 config):
-        self._programs = dict(programs)  # bucket -> call(history_np) -> dev arr
+                 config, *, params_dev=None, fault_plan=None):
+        self._programs = dict(programs)  # bucket -> call(params, hist) -> dev
         self._sup_dev = sup_dev
         self._supports_np = supports_np
         self.normalizer = normalizer
@@ -83,8 +239,24 @@ class ServingEngine:
         self.config = config
         self._buckets = tuple(sorted(self._programs))
         self.stats = EngineStats()
+        # ONE reference holds (generation, device params): dispatches read
+        # it once, swaps replace it whole — a response is never computed
+        # from a mix of generations (CPython reference reads are atomic)
+        self._current = (0, params_dev)
+        self._prepare_params = None   # raw ckpt params -> serving params
+        self._params_template = None  # pytree template for verified loads
+        self._fault_plan = (
+            fault_plan if fault_plan is not None and fault_plan.active else None
+        )
+        self._watcher: Optional[CheckpointWatcher] = None
+        self.admission = (
+            AdmissionController(config, self.stats, self._buckets)
+            if config.deadline_ms is not None or config.queue_bound_rows
+            else None
+        )
         self._batcher = MicroBatcher(
-            self._run_program, self._buckets, config.max_delay_ms, self.stats
+            self._run_program, self._buckets, config.max_delay_ms, self.stats,
+            admission=self.admission, fault_plan=self._fault_plan,
         )
         self._closed = False
 
@@ -110,16 +282,20 @@ class ServingEngine:
         return supports_np
 
     @classmethod
-    def from_forecaster(cls, fc, supports, *, config=None, city=None
-                        ) -> "ServingEngine":
+    def from_forecaster(cls, fc, supports, *, config=None, city=None,
+                        fault_plan=None) -> "ServingEngine":
         """Engine over a live :class:`~stmgcn_tpu.inference.Forecaster`.
 
         The checkpoint's model is rebuilt as its dense serving clone
         (``models.to_dense_serving`` — sparse/looped layouts restacked,
         pallas LSTM re-routed to xla) and each ladder rung compiled AOT
-        with params and supports pinned device-resident. Heterogeneous
-        multi-city checkpoints require ``city=`` exactly like
-        ``export_forecaster``.
+        with the supports pinned device-resident and params an explicit
+        argument (hot-swappable). Heterogeneous multi-city checkpoints
+        require ``city=`` exactly like ``export_forecaster``.
+        ``fault_plan`` threads a deterministic
+        :class:`~stmgcn_tpu.resilience.ServeFaultPlan` through the
+        batcher and checkpoint watcher (tests only; the empty plan is a
+        no-op).
         """
         from stmgcn_tpu.models import to_dense_serving
 
@@ -159,15 +335,24 @@ class ServingEngine:
         for b in cfg.buckets:
             struct = jax.ShapeDtypeStruct((b,) + expected, jnp.float32)
             compiled = jax.jit(fn).lower(params_dev, sup_dev, struct).compile()
-            # params/supports are the SAME resident arrays every call —
-            # the numpy history batch is the only per-request upload
-            # (Compiled takes it as-is; wrapping in jnp.asarray first
-            # just adds a dispatch-path round trip)
-            programs[b] = lambda h, c=compiled: c(params_dev, sup_dev, h)
-        return cls(programs, sup_dev, supports_np, normalizer, expected, cfg)
+            # supports are the SAME resident array every call; params come
+            # from the engine's (generation, params) reference — the numpy
+            # history batch is the only per-request upload (Compiled takes
+            # it as-is; wrapping in jnp.asarray first just adds a
+            # dispatch-path round trip)
+            programs[b] = lambda p, h, c=compiled: c(p, sup_dev, h)
+        engine = cls(programs, sup_dev, supports_np, normalizer, expected,
+                     cfg, params_dev=params_dev, fault_plan=fault_plan)
+        # hot-swap plumbing: raw checkpoint params go through the same
+        # dense-serving transform the ladder was compiled for, and
+        # verified loads restore against the live checkpoint's pytree
+        engine._prepare_params = lambda p: to_dense_serving(fc.model, p, m)[1]
+        engine._params_template = fc.params
+        return engine
 
     @classmethod
-    def from_artifact(cls, source, supports, *, config=None) -> "ServingEngine":
+    def from_artifact(cls, source, supports, *, config=None, fault_plan=None
+                      ) -> "ServingEngine":
         """Engine over an export artifact (path or loaded
         :class:`~stmgcn_tpu.export.ExportedForecaster`).
 
@@ -175,6 +360,8 @@ class ServingEngine:
         compiled per ladder rung. The wrapped predictor is re-routed:
         ``ex.predict(supports, history)`` now goes through the engine's
         buckets (same supports required — the engine pinned them).
+        Artifact params are baked into the StableHLO module, so this
+        flavor cannot ``swap_params`` — rebuild from a new artifact.
         """
         from stmgcn_tpu.export import ExportedForecaster
 
@@ -193,11 +380,64 @@ class ServingEngine:
         for b in cfg.buckets:
             struct = jax.ShapeDtypeStruct((b,) + expected, jnp.float32)
             compiled = jax.jit(ex.exported.call).lower(sup_dev, struct).compile()
-            programs[b] = lambda h, c=compiled: c(sup_dev, h)
-        engine = cls(programs, sup_dev, supports_np, ex.normalizer, expected, cfg)
+            programs[b] = lambda p, h, c=compiled: c(sup_dev, h)
+        engine = cls(programs, sup_dev, supports_np, ex.normalizer, expected,
+                     cfg, fault_plan=fault_plan)
         engine.exported = ex
         ex._engine = engine  # route ex.predict through the bucket ladder
         return engine
+
+    # -- hot swap --------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        """Monotonic param-generation counter (0 = construction params)."""
+        return self._current[0]
+
+    def swap_params(self, params) -> int:
+        """Atomically replace the serving parameters; returns the new
+        generation.
+
+        ``params`` is a *raw checkpoint* pytree (``Forecaster.params``
+        shape) — it goes through the same dense-serving transform the
+        ladder was compiled from, is structure/shape-checked against the
+        live params, placed on device, and published as one reference
+        swap. In-flight dispatches finish on the generation they read at
+        entry; every later dispatch sees the new one. No AOT rebuild:
+        the compiled programs take params as an argument.
+        """
+        if self._prepare_params is None:
+            raise RuntimeError(
+                "this engine was built from_artifact — params are baked "
+                "into the exported StableHLO module; rebuild the engine "
+                "from a new artifact to change them"
+            )
+        new_dev = jax.tree.map(jnp.asarray, self._prepare_params(params))
+        gen, cur_dev = self._current
+        _check_swap_structure(cur_dev, new_dev)
+        self._current = (gen + 1, new_dev)
+        return gen + 1
+
+    def watch_checkpoints(self, out_dir: str, *, poll_s: Optional[float] = None,
+                          log=None) -> CheckpointWatcher:
+        """Hot-swap new verified checkpoints from ``out_dir`` as they land.
+
+        ``poll_s=None`` returns a passive handle — call ``.poll()``
+        yourself (deterministic; what the tests do). With ``poll_s`` a
+        daemon thread polls on that period until ``.stop()`` or the
+        engine closes. Corrupt checkpoints are quarantined by the
+        verified-load chain and never swapped in; the engine keeps its
+        current params (counted in ``watcher.rejected``).
+        """
+        if self._prepare_params is None:
+            raise RuntimeError(
+                "from_artifact engines cannot hot-swap — no checkpoint "
+                "watcher"
+            )
+        if self._watcher is not None:
+            self._watcher.stop()
+        self._watcher = CheckpointWatcher(self, out_dir, poll_s, log)
+        return self._watcher
 
     # -- serving --------------------------------------------------------
 
@@ -205,16 +445,19 @@ class ServingEngine:
     def buckets(self) -> tuple:
         return self._buckets
 
-    def _run_program(self, payload: np.ndarray, bucket: int,
-                     segments) -> np.ndarray:
+    def _run_program(self, payload: np.ndarray, bucket: int, segments):
         """One dispatch: normalize (vectorized, once per *batch* — not
         once per request), pad to the rung, run the compiled program,
-        denormalize. ``segments`` is ``((offset, n_rows, pre_normalized),
-        ...)`` in payload order; pre-normalized rows are kept verbatim.
+        denormalize. Returns ``(predictions, generation)`` — the batcher
+        stamps the generation on every coalesced request, so the stamp
+        is atomic with the params the dispatch actually used.
+        ``segments`` is ``((offset, n_rows, pre_normalized), ...)`` in
+        payload order; pre-normalized rows are kept verbatim.
         Elementwise normalization + row-independent forward keep the
         result bit-identical to the per-request flow."""
         from stmgcn_tpu.serving.bucketing import pad_to_bucket
 
+        gen, params_dev = self._current  # ONE read — whole dispatch, one gen
         norm = self.normalizer
         if norm is None or all(pre for _, _, pre in segments):
             batch = payload
@@ -223,42 +466,87 @@ class ServingEngine:
             for ofs, n, pre in segments:
                 if pre:
                     batch[ofs:ofs + n] = payload[ofs:ofs + n]
-        out = np.asarray(self._programs[bucket](pad_to_bucket(batch, bucket)))
-        return norm.inverse(out) if norm is not None else out
+        out = np.asarray(
+            self._programs[bucket](params_dev, pad_to_bucket(batch, bucket))
+        )
+        return (norm.inverse(out) if norm is not None else out), gen
 
-    def _call_batched(self, history: np.ndarray, normalized: bool
-                      ) -> np.ndarray:
+    def _call_batched(self, history: np.ndarray, normalized: bool):
+        """Micro-batched path; returns ``(out, generation)`` with every
+        chunk of an oversized batch on the SAME generation (stale chunks
+        re-dispatch until the generations agree — gen only moves forward,
+        so the loop converges unless swaps outrun dispatches)."""
         cap = self._buckets[-1]
         if history.shape[0] <= cap:
-            return self._batcher.submit(history, tag=normalized)
-        # oversized batches split into ladder-top chunks (never a request)
-        parts = [
-            self._batcher.submit(history[i:i + cap], tag=normalized)
+            out, gen = self._batcher.submit(
+                history, tag=normalized, with_info=True
+            )
+            return out, gen
+        spans = [
+            (i, min(i + cap, history.shape[0]))
             for i in range(0, history.shape[0], cap)
         ]
-        return np.concatenate(parts, axis=0)
+        parts: list = [None] * len(spans)
+        gens: list = [None] * len(spans)
+        for _ in range(_SWAP_RETRIES):
+            target = max((g for g in gens if g is not None), default=None)
+            for k, (i, j) in enumerate(spans):
+                if gens[k] is None or gens[k] != target:
+                    parts[k], gens[k] = self._batcher.submit(
+                        history[i:j], tag=normalized, with_info=True
+                    )
+            if len(set(gens)) == 1:
+                return np.concatenate(parts, axis=0), gens[0]
+        raise RuntimeError(
+            "could not assemble a single-generation response in "
+            f"{_SWAP_RETRIES} rounds — params are swapping faster than "
+            "dispatches complete"
+        )
 
-    def _call_direct(self, history: np.ndarray, normalized: bool
-                     ) -> np.ndarray:
+    def _dispatch_inline(self, chunk: np.ndarray, normalized: bool):
         import time
 
         from stmgcn_tpu.serving.bucketing import smallest_covering_bucket
 
-        cap = self._buckets[-1]
-        parts = []
-        for i in range(0, history.shape[0], cap):
-            chunk = history[i:i + cap]
-            bucket = smallest_covering_bucket(chunk.shape[0], self._buckets)
-            t0 = time.perf_counter()
-            out = self._run_program(
-                chunk, bucket, ((0, chunk.shape[0], normalized),)
-            )
-            device_ms = (time.perf_counter() - t0) * 1e3
-            self.stats.record_dispatch(
-                bucket, chunk.shape[0], [0.0], device_ms
-            )
-            parts.append(out[:chunk.shape[0]])
-        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+        bucket = smallest_covering_bucket(chunk.shape[0], self._buckets)
+        t0 = time.perf_counter()
+        out, gen = self._run_program(
+            chunk, bucket, ((0, chunk.shape[0], normalized),)
+        )
+        device_ms = (time.perf_counter() - t0) * 1e3
+        self.stats.record_dispatch(bucket, chunk.shape[0], [0.0], device_ms)
+        return out[:chunk.shape[0]], gen
+
+    def _call_direct(self, history: np.ndarray, normalized: bool,
+                     cap: Optional[int] = None):
+        """Inline path; ``cap`` chunks at a smaller rung (the degrade
+        policy's knob). Returns ``(out, generation)`` — same one-
+        generation re-dispatch rule as the batched path."""
+        cap = cap if cap is not None else self._buckets[-1]
+        spans = [
+            (i, min(i + cap, history.shape[0]))
+            for i in range(0, history.shape[0], cap)
+        ]
+        parts: list = [None] * len(spans)
+        gens: list = [None] * len(spans)
+        for _ in range(_SWAP_RETRIES):
+            target = max((g for g in gens if g is not None), default=None)
+            for k, (i, j) in enumerate(spans):
+                if gens[k] is None or gens[k] != target:
+                    parts[k], gens[k] = self._dispatch_inline(
+                        history[i:j], normalized
+                    )
+            if len(set(gens)) == 1:
+                out = (
+                    parts[0] if len(parts) == 1
+                    else np.concatenate(parts, axis=0)
+                )
+                return out, gens[0]
+        raise RuntimeError(
+            "could not assemble a single-generation response in "
+            f"{_SWAP_RETRIES} rounds — params are swapping faster than "
+            "dispatches complete"
+        )
 
     def _validate(self, history) -> np.ndarray:
         history = np.asarray(history, dtype=np.float32)
@@ -270,28 +558,55 @@ class ServingEngine:
             )
         return history
 
-    def predict(self, history, *, normalized: bool = False) -> np.ndarray:
+    def predict(self, history, *, normalized: bool = False,
+                with_generation: bool = False) -> np.ndarray:
         """Micro-batched raw-units forecast — the concurrent-caller path.
 
         Blocks until this request's coalesced dispatch completes; results
         are bit-identical to ``Forecaster.predict`` on the same rows
         (parity pinned in tests/test_serving.py). Normalization happens
         inside the coalesced dispatch, vectorized over the whole bucket.
+
+        Overload behavior (``ServingConfig`` SLO knobs set): sheds raise
+        :class:`~stmgcn_tpu.serving.admission.Overloaded` /
+        :class:`~stmgcn_tpu.serving.admission.DeadlineExceeded` under
+        ``shed_policy="reject"``; ``"degrade"`` serves the request inline
+        at ``degrade_rung`` instead. A wedged batcher (worker died) falls
+        back to the inline path unconditionally — callers never hang.
+        ``with_generation=True`` returns ``(pred, generation)``.
         """
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
-        return self._call_batched(self._validate(history), normalized)
+        h = self._validate(history)
+        try:
+            out, gen = self._call_batched(h, normalized)
+        except BatcherWedged:
+            out, gen = self._call_direct(h, normalized)
+        except ShedError:
+            if self.config.shed_policy != "degrade":
+                raise
+            self.stats.record_shed("degraded")
+            out, gen = self._call_direct(
+                h, normalized,
+                cap=self.config.degrade_rung or self._buckets[0],
+            )
+        return (out, gen) if with_generation else out
 
-    def predict_direct(self, history, *, normalized: bool = False) -> np.ndarray:
+    def predict_direct(self, history, *, normalized: bool = False,
+                       with_generation: bool = False) -> np.ndarray:
         """Bypass the queue: pad to the covering rung and dispatch inline
-        (the latency-critical single-caller path; same results)."""
+        (the latency-critical single-caller path; same results).
+        ``with_generation=True`` returns ``(pred, generation)``."""
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
-        return self._call_direct(self._validate(history), normalized)
+        out, gen = self._call_direct(self._validate(history), normalized)
+        return (out, gen) if with_generation else out
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            if self._watcher is not None:
+                self._watcher.stop()
             self._batcher.close()
 
     def __enter__(self) -> "ServingEngine":

@@ -11,7 +11,10 @@ each class owns per-batch-bucket AOT programs over a
 micro-batcher, and a ``(city -> class)`` routing layer in front lets
 requests for *different cities of one class* coalesce into single
 dispatches (counted in :attr:`cross_city_dispatches`). One checkpoint's
-parameters sit device-resident once, shared by every program.
+parameters sit behind a single atomic ``(generation, params)``
+reference, shared by every program — so one ``swap_params`` (or the
+checkpoint watcher) re-points the entire fleet at once, and every
+class's dispatches stay single-generation.
 
 Bit-parity contract: each coalesced row selects its city's padded
 support stack and real-node count *inside* the program (the gate
@@ -22,18 +25,36 @@ results are bit-identical to per-city ``Forecaster.predict``, pinned in
 tests/test_fleet.py. Cities the planner leaves unassigned (pad waste
 over budget) still serve: each gets a private exact-fit class.
 
+Overload behavior matches the single-city engine: SLO admission + typed
+sheds per class queue, ``shed_policy="degrade"`` serves inline at the
+degrade rung, a wedged class batcher degrades that class to the inline
+path. Fault plans address each class's dispatch stream independently
+(ordinals are per-batcher).
+
 Import-leanness contract (same as engine.py): jax/numpy only at module
 scope; the model stack loads lazily inside ``from_forecaster``.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from stmgcn_tpu.serving.engine import ServingEngine
+from stmgcn_tpu.serving.admission import (
+    AdmissionController,
+    BatcherWedged,
+    ShedError,
+)
+from stmgcn_tpu.serving.engine import (
+    _SWAP_RETRIES,
+    CheckpointWatcher,
+    ServingEngine,
+    _check_swap_structure,
+)
 from stmgcn_tpu.serving.metrics import EngineStats
 from stmgcn_tpu.serving.microbatch import MicroBatcher
 
@@ -47,7 +68,9 @@ def fleet_bucket_fn(model):
     from the class-level operands (pure index copies) and runs the
     eval-mode forward with the traced count feeding the gate pooling —
     one compiled program per (class, bucket) serves every member city.
-    Traced by the jaxpr contract pass as ``serve_fleet_bucket``.
+    Params stay an explicit argument (hot-swappable, exactly like
+    ``serve_bucket_fn``). Traced by the jaxpr contract pass as
+    ``serve_fleet_bucket``.
     """
 
     def serve_fleet_bucket(params, sup_stack, n_arr, slots, history):
@@ -69,18 +92,20 @@ class FleetServingEngine:
         engine = FleetServingEngine.from_forecaster(fc, city_supports)
         pred = engine.predict(history, city=1)        # micro-batched
         pred = engine.predict_direct(history, city=0) # bypass the queue
+        engine.swap_params(new_params)                # whole fleet, atomic
         engine.class_stats[engine.class_of(1)].snapshot()
         engine.cross_city_dispatches                  # coalescing proof
         engine.close()
     """
 
     def __init__(self, plan, groups, programs, batch_buckets, normalizers,
-                 city_n, seq_len, input_dim, config):
+                 city_n, seq_len, input_dim, config, *, params_dev=None,
+                 fault_plan=None):
         #: the shape-class plan (extra exact-fit classes for unassigned
         #: cities appear in ``groups`` only)
         self.plan = plan
         self._groups = tuple(groups)  # (rung, (city, ...)) per class
-        self._programs = programs  # cls_id -> {bucket: call(slots, hist)}
+        self._programs = programs  # cls_id -> {bucket: call(p, slots, hist)}
         self._buckets = tuple(sorted(batch_buckets))
         self._normalizers = list(normalizers)
         self._city_n = list(city_n)
@@ -96,9 +121,27 @@ class FleetServingEngine:
         #: dispatches whose coalesced rows spanned >1 city — the fleet
         #: engine's reason to exist; per-city engines can never coalesce
         self.cross_city_dispatches = 0
+        # one (generation, params) reference for the whole fleet: every
+        # class's dispatch reads it once, one swap re-points all classes
+        self._current = (0, params_dev)
+        self._prepare_params = None
+        self._params_template = None
+        self._fault_plan = (
+            fault_plan if fault_plan is not None and fault_plan.active else None
+        )
+        self._watcher: Optional[CheckpointWatcher] = None
         #: per-class telemetry (bucket keys are batch rungs)
         self.class_stats = {
             ci: EngineStats() for ci in range(len(self._groups))
+        }
+        slo = config.deadline_ms is not None or config.queue_bound_rows
+        self.class_admission = {
+            ci: (
+                AdmissionController(config, self.class_stats[ci],
+                                    self._buckets)
+                if slo else None
+            )
+            for ci in range(len(self._groups))
         }
         self._batchers = {
             ci: MicroBatcher(
@@ -108,6 +151,8 @@ class FleetServingEngine:
                 self._buckets,
                 config.max_delay_ms,
                 self.class_stats[ci],
+                admission=self.class_admission[ci],
+                fault_plan=self._fault_plan,
             )
             for ci in range(len(self._groups))
         }
@@ -117,15 +162,16 @@ class FleetServingEngine:
 
     @classmethod
     def from_forecaster(cls, fc, city_supports, *, config=None,
-                        max_classes: int = 8, max_pad_waste: float = 0.5
-                        ) -> "FleetServingEngine":
+                        max_classes: int = 8, max_pad_waste: float = 0.5,
+                        fault_plan=None) -> "FleetServingEngine":
         """Engine over a heterogeneous multi-city checkpoint.
 
         ``city_supports``: one dense ``(M, K, n_c, n_c)`` stack per city
         (a :class:`~stmgcn_tpu.train.CitySupports` or a plain sequence).
         The checkpoint's model is rebuilt as its dense serving clone and
-        every (class, batch-bucket) pair compiled AOT with parameters and
-        the class's rung-padded support stack pinned device-resident.
+        every (class, batch-bucket) pair compiled AOT with the class's
+        rung-padded support stack pinned device-resident and parameters
+        an explicit (hot-swappable) argument.
         """
         from stmgcn_tpu.data.fleet import plan_shape_classes
         from stmgcn_tpu.models import to_dense_serving
@@ -196,11 +242,43 @@ class FleetServingEngine:
                     .compile()
                 )
                 programs[ci][b] = (
-                    lambda slots, h, c_=compiled, sd=stack_dev, nd=n_arr_dev:
-                    c_(params_dev, sd, nd, slots, h)
+                    lambda p, slots, h, c_=compiled, sd=stack_dev,
+                    nd=n_arr_dev: c_(p, sd, nd, slots, h)
                 )
-        return cls(plan, groups, programs, cfg.buckets, normalizers,
-                   n_nodes, seq_len, input_dim, cfg)
+        engine = cls(plan, groups, programs, cfg.buckets, normalizers,
+                     n_nodes, seq_len, input_dim, cfg,
+                     params_dev=params_dev, fault_plan=fault_plan)
+        engine._prepare_params = lambda p: to_dense_serving(fc.model, p, m)[1]
+        engine._params_template = fc.params
+        return engine
+
+    # -- hot swap --------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        """Monotonic param-generation counter (0 = construction params)."""
+        return self._current[0]
+
+    def swap_params(self, params) -> int:
+        """Atomically re-point every shape class at new parameters;
+        returns the new generation (same contract as
+        :meth:`ServingEngine.swap_params` — raw checkpoint pytree in,
+        one reference swap, no AOT rebuild)."""
+        new_dev = jax.tree.map(jnp.asarray, self._prepare_params(params))
+        gen, cur_dev = self._current
+        _check_swap_structure(cur_dev, new_dev)
+        self._current = (gen + 1, new_dev)
+        return gen + 1
+
+    def watch_checkpoints(self, out_dir: str, *, poll_s: Optional[float] = None,
+                          log=None) -> CheckpointWatcher:
+        """Hot-swap new verified checkpoints (see
+        :meth:`ServingEngine.watch_checkpoints` — identical semantics,
+        fleet-wide swap)."""
+        if self._watcher is not None:
+            self._watcher.stop()
+        self._watcher = CheckpointWatcher(self, out_dir, poll_s, log)
+        return self._watcher
 
     # -- serving --------------------------------------------------------
 
@@ -224,8 +302,9 @@ class FleetServingEngine:
             )
 
     def _run_program(self, cls_id: int, payload: np.ndarray, bucket: int,
-                     segments) -> np.ndarray:
-        """One coalesced dispatch for a shape class.
+                     segments):
+        """One coalesced dispatch for a shape class; returns
+        ``(predictions, generation)``.
 
         ``segments`` is ``((offset, n_rows, (city, pre_normalized)), ...)``
         in payload order. Normalization runs per segment over the city's
@@ -235,6 +314,7 @@ class FleetServingEngine:
         """
         from stmgcn_tpu.serving.bucketing import pad_to_bucket
 
+        gen, params_dev = self._current  # ONE read — whole dispatch, one gen
         if all(pre for _, _, (_, pre) in segments):
             batch = payload
         else:
@@ -250,7 +330,9 @@ class FleetServingEngine:
         for ofs, n, (c, _) in segments:
             slots[ofs:ofs + n] = self._city_slot[c]
         out = np.array(
-            self._programs[cls_id][bucket](slots, pad_to_bucket(batch, bucket))
+            self._programs[cls_id][bucket](
+                params_dev, slots, pad_to_bucket(batch, bucket)
+            )
         )
         for ofs, n, (c, _) in segments:
             norm = self._normalizers[c]
@@ -261,7 +343,7 @@ class FleetServingEngine:
                 )
         if len({c for _, _, (c, _) in segments}) > 1:
             self.cross_city_dispatches += 1
-        return out
+        return out, gen
 
     def _validate(self, history, city: int) -> np.ndarray:
         self._check_city(city)
@@ -285,61 +367,123 @@ class FleetServingEngine:
         nc = self._city_n[city]
         return out[..., :nc, :] if out.shape[-2] != nc else out
 
-    def predict(self, history, *, city: int, normalized: bool = False
-                ) -> np.ndarray:
-        """Micro-batched raw-units forecast for one city.
-
-        Concurrent callers — including callers for *other cities of the
-        same shape class* — coalesce into one dispatch. Bit-identical to
-        ``Forecaster.predict(..., city=city)`` on the same rows.
-        """
-        if self._closed:
-            raise RuntimeError("FleetServingEngine is closed")
-        h = self._pad_city(self._validate(history, city), city)
+    def _call_batched(self, h: np.ndarray, city: int, normalized: bool):
         batcher = self._batchers[self._city_cls[city]]
         cap = self._buckets[-1]
         if h.shape[0] <= cap:
-            out = batcher.submit(h, tag=(city, normalized))
-        else:  # oversized batches split into ladder-top chunks
-            out = np.concatenate([
-                batcher.submit(h[i:i + cap], tag=(city, normalized))
-                for i in range(0, h.shape[0], cap)
-            ], axis=0)
-        return self._strip(out, city)
+            return batcher.submit(h, tag=(city, normalized), with_info=True)
+        # oversized batches split into ladder-top chunks; stale chunks
+        # re-dispatch until every chunk is on one param generation
+        spans = [
+            (i, min(i + cap, h.shape[0])) for i in range(0, h.shape[0], cap)
+        ]
+        parts: list = [None] * len(spans)
+        gens: list = [None] * len(spans)
+        for _ in range(_SWAP_RETRIES):
+            target = max((g for g in gens if g is not None), default=None)
+            for k, (i, j) in enumerate(spans):
+                if gens[k] is None or gens[k] != target:
+                    parts[k], gens[k] = batcher.submit(
+                        h[i:j], tag=(city, normalized), with_info=True
+                    )
+            if len(set(gens)) == 1:
+                return np.concatenate(parts, axis=0), gens[0]
+        raise RuntimeError(
+            "could not assemble a single-generation response in "
+            f"{_SWAP_RETRIES} rounds — params are swapping faster than "
+            "dispatches complete"
+        )
 
-    def predict_direct(self, history, *, city: int, normalized: bool = False
-                       ) -> np.ndarray:
-        """Bypass the queue: pad to the covering rung and dispatch inline
-        (same results; no coalescing)."""
+    def _dispatch_inline(self, chunk: np.ndarray, city: int, normalized: bool):
         import time
 
         from stmgcn_tpu.serving.bucketing import smallest_covering_bucket
 
+        cls_id = self._city_cls[city]
+        bucket = smallest_covering_bucket(chunk.shape[0], self._buckets)
+        t0 = time.perf_counter()
+        out, gen = self._run_program(
+            cls_id, chunk, bucket, ((0, chunk.shape[0], (city, normalized)),)
+        )
+        device_ms = (time.perf_counter() - t0) * 1e3
+        self.class_stats[cls_id].record_dispatch(
+            bucket, chunk.shape[0], [0.0], device_ms
+        )
+        return out[:chunk.shape[0]], gen
+
+    def _call_direct(self, h: np.ndarray, city: int, normalized: bool,
+                     cap: Optional[int] = None):
+        cap = cap if cap is not None else self._buckets[-1]
+        spans = [
+            (i, min(i + cap, h.shape[0])) for i in range(0, h.shape[0], cap)
+        ]
+        parts: list = [None] * len(spans)
+        gens: list = [None] * len(spans)
+        for _ in range(_SWAP_RETRIES):
+            target = max((g for g in gens if g is not None), default=None)
+            for k, (i, j) in enumerate(spans):
+                if gens[k] is None or gens[k] != target:
+                    parts[k], gens[k] = self._dispatch_inline(
+                        h[i:j], city, normalized
+                    )
+            if len(set(gens)) == 1:
+                out = (
+                    parts[0] if len(parts) == 1
+                    else np.concatenate(parts, axis=0)
+                )
+                return out, gens[0]
+        raise RuntimeError(
+            "could not assemble a single-generation response in "
+            f"{_SWAP_RETRIES} rounds — params are swapping faster than "
+            "dispatches complete"
+        )
+
+    def predict(self, history, *, city: int, normalized: bool = False,
+                with_generation: bool = False) -> np.ndarray:
+        """Micro-batched raw-units forecast for one city.
+
+        Concurrent callers — including callers for *other cities of the
+        same shape class* — coalesce into one dispatch. Bit-identical to
+        ``Forecaster.predict(..., city=city)`` on the same rows. Typed
+        sheds / degrade / wedged-batcher fallback behave exactly like
+        :meth:`ServingEngine.predict`; ``with_generation=True`` returns
+        ``(pred, generation)``.
+        """
         if self._closed:
             raise RuntimeError("FleetServingEngine is closed")
         h = self._pad_city(self._validate(history, city), city)
-        cls_id = self._city_cls[city]
-        cap = self._buckets[-1]
-        parts = []
-        for i in range(0, h.shape[0], cap):
-            chunk = h[i:i + cap]
-            bucket = smallest_covering_bucket(chunk.shape[0], self._buckets)
-            t0 = time.perf_counter()
-            out = self._run_program(
-                cls_id, chunk, bucket,
-                ((0, chunk.shape[0], (city, normalized)),),
+        try:
+            out, gen = self._call_batched(h, city, normalized)
+        except BatcherWedged:
+            out, gen = self._call_direct(h, city, normalized)
+        except ShedError:
+            if self.config.shed_policy != "degrade":
+                raise
+            self.class_stats[self._city_cls[city]].record_shed("degraded")
+            out, gen = self._call_direct(
+                h, city, normalized,
+                cap=self.config.degrade_rung or self._buckets[0],
             )
-            device_ms = (time.perf_counter() - t0) * 1e3
-            self.class_stats[cls_id].record_dispatch(
-                bucket, chunk.shape[0], [0.0], device_ms
-            )
-            parts.append(out[:chunk.shape[0]])
-        out = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
-        return self._strip(out, city)
+        out = self._strip(out, city)
+        return (out, gen) if with_generation else out
+
+    def predict_direct(self, history, *, city: int, normalized: bool = False,
+                       with_generation: bool = False) -> np.ndarray:
+        """Bypass the queue: pad to the covering rung and dispatch inline
+        (same results; no coalescing). ``with_generation=True`` returns
+        ``(pred, generation)``."""
+        if self._closed:
+            raise RuntimeError("FleetServingEngine is closed")
+        h = self._pad_city(self._validate(history, city), city)
+        out, gen = self._call_direct(h, city, normalized)
+        out = self._strip(out, city)
+        return (out, gen) if with_generation else out
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            if self._watcher is not None:
+                self._watcher.stop()
             for b in self._batchers.values():
                 b.close()
 

@@ -54,6 +54,9 @@ class EngineStats:
         self._buckets: Dict[int, _BucketStats] = {}
         self._t_first = None  # wall window over all dispatches, for
         self._t_last = None   # end-to-end throughput
+        #: admission-control rejections by reason ("overloaded" /
+        #: "deadline"); admitted = totals.requests
+        self._shed: Dict[str, int] = {}
 
     def record_dispatch(self, bucket: int, rows: int, queue_ms: List[float],
                         device_ms: float) -> None:
@@ -74,10 +77,28 @@ class EngineStats:
             if self._t_last is None or now > self._t_last:
                 self._t_last = now
 
+    def record_shed(self, reason: str) -> None:
+        """One admission-control rejection (``"overloaded"`` at the queue
+        bound, ``"deadline"`` at the wait estimate or in-queue expiry)."""
+        with self._lock:
+            self._shed[reason] = self._shed.get(reason, 0) + 1
+
+    def device_ms_estimate(self, bucket: int, default: float = 0.0) -> float:
+        """Measured mean device time per dispatch for ``bucket`` — the
+        admission controller's wait model. Falls back to the mean over
+        every rung, then to ``default``, while the rung is still cold."""
+        with self._lock:
+            bs = self._buckets.get(bucket)
+            if bs is not None and bs.device_ms:
+                return float(np.mean(bs.device_ms))
+            samples = [v for b in self._buckets.values() for v in b.device_ms]
+        return float(np.mean(samples)) if samples else default
+
     def reset(self) -> None:
         with self._lock:
             self._buckets.clear()
             self._t_first = self._t_last = None
+            self._shed.clear()
 
     def snapshot(self) -> dict:
         """A JSON-ready view: per-bucket percentiles + engine totals."""
@@ -92,6 +113,7 @@ class EngineStats:
                 if self._t_first is not None and self._t_last > self._t_first
                 else None
             )
+            shed = dict(self._shed)
         out: dict = {"buckets": {}, "totals": {}}
         tot_rows = tot_reqs = tot_disp = tot_capacity = 0
         all_queue: List[float] = []
@@ -123,5 +145,6 @@ class EngineStats:
             "queue_wait_ms_mean": percentiles(all_queue)["mean"],
             "device_ms_mean": percentiles(all_device)["mean"],
             "rows_per_sec": round(tot_rows / window, 1) if window else None,
+            "shed": shed,
         }
         return out

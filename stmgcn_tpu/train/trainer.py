@@ -295,7 +295,12 @@ class Trainer:
         self._resume_skip = 0
         self._epoch_losses: list = []
         self._epoch_counts: list = []
-        self._deferred: list = []  # guard action="defer" end-of-epoch retries
+        # guard action="defer" end-of-epoch retries, as (ordinal, batch)
+        # pairs — the ordinal (position in the epoch's deterministic batch
+        # order) is what mid-epoch checkpoints persist, so a resumed run
+        # can re-materialize the pending retries bit-exactly
+        self._deferred: list = []
+        self._resume_deferred: list = []  # ordinals restored from meta
         self._preempted = False  # SIGTERM arrived; unwind at next safe point
         self._last_cadence_step = 0
         self._lr_scale = 1.0  # cumulative divergence-guard LR cut
@@ -758,6 +763,11 @@ class Trainer:
                 ],
                 "counts": [int(c) for c in self._epoch_counts],
             }
+            if self._deferred:
+                # divergence-guard "defer" retries still pending at this
+                # save: persist their batch ordinals so a resume replays
+                # them at epoch end instead of silently dropping them
+                meta["deferred"] = [ordinal for ordinal, _ in self._deferred]
         if getattr(self.dataset, "heterogeneous", False):
             meta["normalizers"] = [
                 n.to_dict() if n is not None else None
@@ -1142,6 +1152,36 @@ class Trainer:
         if skip == 0:
             self._epoch_losses, self._epoch_counts = [], []
         self._deferred = []
+        resume_deferred, self._resume_deferred = self._resume_deferred, []
+        if resume_deferred:
+            # mid-epoch resume with guard-deferred batches pending: the
+            # epoch's batch order is deterministic in (seed, shuffle,
+            # epoch), so the persisted ordinals re-materialize the exact
+            # batches the interrupted run was going to retry. They come
+            # first in ordinal order; batches deferred after the resume
+            # point have larger ordinals, so the combined retry order
+            # matches the uninterrupted run's bit-exactly.
+            want = set(resume_deferred)
+            for ordinal, batch in enumerate(self.dataset.batches(
+                mode,
+                self.batch_size,
+                shuffle=self.shuffle,
+                seed=self.seed,
+                epoch=self.epoch,
+                pad_last=True,
+                with_arrays=not self._resident,
+            )):
+                if ordinal in want:
+                    self._deferred.append((ordinal, batch))
+                    want.discard(ordinal)
+                    if not want:
+                        break
+            if want:
+                raise ValueError(
+                    f"mid-epoch checkpoint defers batch ordinals "
+                    f"{sorted(want)} that this epoch does not produce — "
+                    "checkpoint from a different data configuration?"
+                )
         # resume points landing mid-remainder (skip % S != 0) take the
         # per-step loop for the rest of the epoch — bit-identical to the
         # superstep by the PR 2 parity contract, just unfused
@@ -1155,7 +1195,7 @@ class Trainer:
         else:
             self._run_train_epoch_steps(mode, skip)
         deferred, self._deferred = self._deferred, []
-        for batch in deferred:  # guard action="defer": one retry at epoch end
+        for _, batch in deferred:  # guard action="defer": one retry at epoch end
             x, y, mask = self._place_batch(batch, mode)
             self._train_one(batch, x, y, mask, retry=True)
             self._after_train_batch()
@@ -1220,7 +1260,7 @@ class Trainer:
                 self._set_lr_scale(self._lr_scale * guard.lr_cut)
             guard.trip(float(loss), self.epoch, step)
             if guard.action == "defer" and not retry:
-                self._deferred.append(batch)
+                self._deferred.append((step, batch))
             return  # no loss/count recorded; global_step does not advance
         if guard is not None:
             guard.ok()
@@ -1738,9 +1778,13 @@ class Trainer:
             self._epoch_losses = [np.float32(v) for v in partial["losses"]]
             self._epoch_counts = [int(c) for c in partial["counts"]]
             self._batch_in_epoch = self._resume_skip
+            self._resume_deferred = [
+                int(o) for o in meta.get("deferred", [])
+            ]
         else:
             self._epoch_losses, self._epoch_counts = [], []
             self._batch_in_epoch = 0
+            self._resume_deferred = []
 
     def restore(self, path: Optional[str] = None) -> dict:
         """Load a checkpoint into the live trainer state.

@@ -747,6 +747,76 @@ class TestServingBucketRule:
         assert check_serving_buckets([("none", object())]) == []
 
 
+class TestServingSLORule:
+    """Pass 2f: the serving-slo admission contract — SLO knob combinations
+    that construct an admission controller that can never behave as
+    intended, caught from pure config math at lint time. The boundaries
+    are pinned exactly: one unit past each threshold must go clean."""
+
+    @staticmethod
+    def _cfg(**kw):
+        from stmgcn_tpu.config import ServingConfig, preset
+
+        base = dict(buckets=(1, 2, 4), max_batch=4, max_delay_ms=5.0)
+        base.update(kw)
+        cfg = preset("smoke")
+        cfg.serving = ServingConfig(**base)
+        return cfg
+
+    def test_rule_registered_as_error(self):
+        assert RULES["serving-slo"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_serving_slo
+
+        assert check_serving_slo() == []
+
+    def test_deadline_at_coalescing_floor_flagged(self):
+        from stmgcn_tpu.analysis import check_serving_slo
+
+        f = check_serving_slo([("bad", self._cfg(deadline_ms=5.0))])
+        assert f and all(x.rule == "serving-slo" for x in f)
+        assert all(x.severity == "error" for x in f)
+        assert any("max_delay_ms" in x.message for x in f)
+        assert f[0].path == "<contract:serving:bad>"
+        # one epsilon above the floor is servable
+        assert check_serving_slo([("ok", self._cfg(deadline_ms=5.1))]) == []
+
+    def test_queue_bound_below_top_rung_flagged(self):
+        from stmgcn_tpu.analysis import check_serving_slo
+
+        f = check_serving_slo([("bad", self._cfg(queue_bound_rows=3))])
+        assert any("top" in x.message and "rung" in x.message for x in f)
+        # exactly the top rung can fill one saturated dispatch: clean
+        assert check_serving_slo([("ok", self._cfg(queue_bound_rows=4))]) == []
+        # zero means unbounded, not "a bound of zero": clean
+        assert check_serving_slo([("ok", self._cfg(queue_bound_rows=0))]) == []
+
+    def test_degrade_rung_misconfigurations_flagged(self):
+        from stmgcn_tpu.analysis import check_serving_slo
+
+        off_ladder = self._cfg(shed_policy="degrade", degrade_rung=3)
+        f = check_serving_slo([("bad", off_ladder)])
+        assert any("not a ladder rung" in x.message for x in f)
+        unused = self._cfg(shed_policy="reject", degrade_rung=2)
+        f = check_serving_slo([("bad", unused)])
+        assert any("never be used" in x.message for x in f)
+        assert check_serving_slo(
+            [("ok", self._cfg(shed_policy="degrade", degrade_rung=2))]
+        ) == []
+
+    def test_bad_shed_policy_flagged(self):
+        from stmgcn_tpu.analysis import check_serving_slo
+
+        f = check_serving_slo([("bad", self._cfg(shed_policy="retry"))])
+        assert any("shed_policy" in x.message for x in f)
+
+    def test_configs_without_serving_section_skipped(self):
+        from stmgcn_tpu.analysis import check_serving_slo
+
+        assert check_serving_slo([("none", object())]) == []
+
+
 class TestResidentMemoryRule:
     """Pass 2f: the resident-memory footprint contract (pure config math
     — the same arithmetic as DemandDataset.resident_nbytes/nbytes,

@@ -179,3 +179,45 @@ def test_serving_bench_record_contract(tmp_path):
         assert {"queue_wait_ms", "device_ms", "latency_ms",
                 "pad_waste"} <= set(stats)
     assert rec["host_load"]["lock"]["acquired"] is True
+
+
+def test_serve_bench_soak_record_contract():
+    """``serve-bench --soak``: the one-JSON-line stdout contract holds
+    with the overload leg on, and the soak record carries the operability
+    evidence — typed shed counts, zero hung clients, and the mid-soak
+    hot-swap with bit parity on both generations."""
+    import json
+    import subprocess
+
+    env = {**CLEAN_ENV, "JAX_PLATFORMS": "cpu",
+           "STMGCN_BENCH_LOCK_PATH": "/tmp/stmgcn_serve_test.lock"}
+    cmd = [
+        sys.executable, "-m", "stmgcn_tpu.cli", "serve-bench",
+        "--rows", "3", "--batch", "4", "--buckets", "1,2,4",
+        "--clients", "4", "--per-client", "4", "--iters", "5",
+        "--warmup", "1", "--no-fleet",
+        "--soak", "--soak-seconds", "1.0", "--soak-overload", "2.0",
+    ]
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 1, f"stdout not a single record line: {proc.stdout!r}"
+    soak = json.loads(lines[0])["soak"]
+    # offered load is fully accounted for: served, shed, or neither —
+    # never a hung caller
+    assert soak["hung_clients"] == 0
+    assert (soak["admitted"] + sum(soak["shed"].values())
+            <= soak["config"]["offered_requests"])
+    assert soak["admitted"] > 0
+    assert soak["calibration"]["per_dispatch_ms"] > 0
+    assert soak["slo_target_ms"] > soak["config"]["deadline_ms"] > 0
+    assert soak["admitted_latency_ms"]["p99"] is not None
+    assert isinstance(soak["contended"], bool)
+    # the mid-soak atomic swap landed and BOTH generations are bit-exact
+    # against their reference predictors
+    hs = soak["hot_swap"]
+    assert hs["swap_error"] is None
+    assert hs["swap_applied"] is True and hs["generation_after"] == 1
+    assert hs["parity_gen0"] is True and hs["parity_gen1"] is True

@@ -352,6 +352,39 @@ class TestDivergenceGuard:
         with pytest.raises(DivergenceError, match="--checkify nan"):
             tr.train()
 
+    def test_deferred_batches_survive_midepoch_resume(self, tmp_path):
+        """A SIGTERM landing between a guard defer and its end-of-epoch
+        retry must not lose the deferred batch: its ordinal is persisted
+        in the mid-epoch checkpoint, re-materialized on resume from the
+        epoch's deterministic batch order, and retried in the same slot —
+        the resumed run ends bit-identical to an uninterrupted one."""
+        ref = build(
+            tmp_path / "ref",
+            fault_plan=FaultPlan(FaultSpec("poison", epoch=1, step=1)),
+            divergence_guard=True, divergence_action="defer",
+        )
+        ref.train()
+        assert ref._guard.total == 1
+
+        plan = FaultPlan(
+            FaultSpec("poison", epoch=1, step=1),
+            FaultSpec("sigterm", epoch=1, step=3),
+        )
+        faulted = build(tmp_path / "run", fault_plan=plan,
+                        divergence_guard=True, divergence_action="defer")
+        with pytest.raises(Preempted):
+            faulted.train()
+        meta = verify_checkpoint(faulted.latest_path)
+        assert meta["epoch"] == 1 and meta["batch_in_epoch"] > 0
+        assert meta["deferred"] == [1]  # the pending retry, by ordinal
+
+        resumed = build(tmp_path / "run", divergence_guard=True,
+                        divergence_action="defer")
+        assert resumed.restore_auto() is not None
+        resumed.train()
+        same(ref.params, resumed.params)
+        same(ref.opt_state, resumed.opt_state)
+
     def test_lr_cut_applied_and_persisted(self, tmp_path):
         tr = build(
             tmp_path,

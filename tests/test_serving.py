@@ -1,4 +1,5 @@
-"""ServingEngine contracts: padding parity, micro-batching, artifacts.
+"""ServingEngine contracts: padding parity, micro-batching, artifacts,
+typed overload sheds, atomic hot-swap, and the serving fault harness.
 
 Train-free like tests/test_export.py — a freshly-initialized flagship
 plus a fitted normalizer pins everything that matters: AOT bucket
@@ -6,9 +7,12 @@ programs, BIT-exact padding parity against ``Forecaster.predict`` (the
 forward is row-independent and the normalizer elementwise, so padded
 rows must never perturb real rows — equality, not allclose), the
 micro-batcher's dispatch policy, and the per-shape program cache that
-fixes the ``ExportedForecaster.predict`` batch-scaling bug.
+fixes the ``ExportedForecaster.predict`` batch-scaling bug. The
+robustness sections drive every failure path deterministically through
+:class:`~stmgcn_tpu.resilience.ServeFaultPlan` — never by anecdote.
 """
 
+import os
 import threading
 import time
 
@@ -28,7 +32,19 @@ from stmgcn_tpu.experiment import build_model
 from stmgcn_tpu.export import ExportedForecaster, export_forecaster
 from stmgcn_tpu.inference import Forecaster
 from stmgcn_tpu.ops import SupportConfig
-from stmgcn_tpu.serving import EngineStats, MicroBatcher, ServingEngine
+from stmgcn_tpu.resilience import ServeFaultPlan, ServeFaultSpec
+from stmgcn_tpu.serving import (
+    AdmissionController,
+    BatcherWedged,
+    DeadlineExceeded,
+    DispatchError,
+    EngineStats,
+    MicroBatcher,
+    Overloaded,
+    ServingEngine,
+    ShedError,
+)
+from stmgcn_tpu.train.checkpoint import save_checkpoint
 
 LADDER = ServingConfig(buckets=(1, 2, 4), max_batch=4, max_delay_ms=5.0)
 
@@ -275,3 +291,355 @@ def test_microbatcher_dispatch_error_released_to_caller():
     with pytest.raises(RuntimeError, match="device fell over"):
         mb.submit(_rows(1.0))
     mb.close()
+
+
+# -- typed failure contract --------------------------------------------
+
+
+def test_dispatch_error_reaches_every_coalesced_waiter():
+    """Each waiter of a dead coalesced dispatch gets its OWN typed
+    DispatchError carrying the batch context, with the device error as
+    ``__cause__`` — not a shared bare exception."""
+    def dispatch(payload, bucket, segments):
+        time.sleep(0.05)  # keep the worker busy so later arrivals coalesce
+        raise RuntimeError("device fell over")
+
+    mb = MicroBatcher(dispatch, (1, 2, 4), max_delay_ms=30.0,
+                      stats=EngineStats())
+    errors = {}
+
+    def client(i, n):
+        try:
+            mb.submit(_rows(float(i), n=n))
+        except Exception as e:  # noqa: BLE001 — capturing for assertions
+            errors[i] = e
+
+    first = threading.Thread(target=client, args=(0, 4))  # saturates: dispatch 0
+    first.start()
+    time.sleep(0.02)  # worker now inside dispatch 0; these three queue up
+    rest = [threading.Thread(target=client, args=(i, 1)) for i in (1, 2, 3)]
+    for t in rest:
+        t.start()
+    for t in [first] + rest:
+        t.join(timeout=30)
+    mb.close()
+    assert sorted(errors) == [0, 1, 2, 3]
+    assert len({id(e) for e in errors.values()}) == 4  # own instance each
+    for e in errors.values():
+        assert isinstance(e, DispatchError)
+        assert isinstance(e.__cause__, RuntimeError)
+        assert "device fell over" in str(e)
+        assert e.bucket == 4
+    assert errors[0].requests == 1 and errors[0].rows == 4
+    # clients 1-3 coalesced behind the busy worker into one dispatch
+    assert errors[1].requests == 3 and errors[1].rows == 3
+
+
+def test_submit_after_close_raises_immediately():
+    mb = MicroBatcher(lambda p, b, s: p, (1, 2), max_delay_ms=1.0,
+                      stats=EngineStats())
+    mb.close()
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit(_rows(0.0))
+    assert time.perf_counter() - t0 < 1.0  # fail-fast, no queue wait
+
+
+def test_batcher_death_releases_waiters_and_fails_fast():
+    """An injected worker death (BaseException at dispatch entry) wedges
+    the batcher: the in-flight waiter is released with BatcherWedged and
+    later submits raise it immediately instead of blocking forever."""
+    plan = ServeFaultPlan(ServeFaultSpec(kind="batcher-die", dispatch=0))
+    mb = MicroBatcher(lambda p, b, s: p, (1, 2, 4), max_delay_ms=5.0,
+                      stats=EngineStats(), fault_plan=plan)
+    with pytest.raises(BatcherWedged) as exc:
+        mb.submit(_rows(0.0, n=4))
+    assert exc.value.__cause__ is not None  # the BatcherKilled fault
+    for _ in range(200):  # the worker protector marks death asynchronously
+        if mb.wedged:
+            break
+        time.sleep(0.01)
+    assert mb.wedged
+    t0 = time.perf_counter()
+    with pytest.raises(BatcherWedged):
+        mb.submit(_rows(1.0))
+    assert time.perf_counter() - t0 < 1.0
+    mb.close()
+
+
+def test_engine_survives_batcher_death_inline(setup):
+    """A wedged batcher degrades ``predict`` to the inline path — the
+    caller whose dispatch died is still served, as is everyone after."""
+    fc, supports, ds = setup
+    plan = ServeFaultPlan(ServeFaultSpec(kind="batcher-die", dispatch=0))
+    eng = ServingEngine.from_forecaster(fc, supports, config=LADDER,
+                                        fault_plan=plan)
+    try:
+        hist = _hist(fc, ds, 2)
+        ref = fc.predict(supports, hist)
+        np.testing.assert_array_equal(eng.predict(hist), ref)
+        np.testing.assert_array_equal(eng.predict(hist), ref)
+        assert eng._batcher.wedged
+    finally:
+        eng.close()
+
+
+# -- SLO admission control ---------------------------------------------
+
+
+def _slo_config(**kw):
+    base = dict(buckets=(1, 2, 4), max_batch=4, max_delay_ms=1.0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def test_admission_controller_typed_sheds():
+    cfg = _slo_config(deadline_ms=10.0, queue_bound_rows=8)
+    assert cfg.violations() == []
+    stats = EngineStats()
+    adm = AdmissionController(cfg, stats, (1, 2, 4))
+    # cold stats: the wait floor is the coalescing delay itself
+    assert adm.estimated_wait_ms(8) == pytest.approx(2 * 1.0)
+    adm.admit(4, 0)
+    adm.admit(4, 4)  # fills the bound exactly: admitted
+    with pytest.raises(Overloaded, match="bound"):
+        adm.admit(1, 8)
+    # teach the wait model: 6 ms per top-rung dispatch measured
+    stats.record_dispatch(4, 4, [0.0], 6.0)
+    assert adm.estimated_wait_ms(8) == pytest.approx(12.0)
+    unbounded = AdmissionController(
+        _slo_config(deadline_ms=10.0, queue_bound_rows=0), stats, (1, 2, 4)
+    )
+    unbounded.admit(1, 7)  # one dispatch ahead: 6 ms fits the deadline
+    with pytest.raises(DeadlineExceeded, match="estimated queue wait"):
+        unbounded.admit(1, 8)  # two ahead: 12 ms cannot
+    assert stats.snapshot()["totals"]["shed"] == {
+        "overloaded": 1, "deadline": 1
+    }
+
+
+def test_queued_deadline_expiry_shed_at_dispatch_boundary():
+    """A request admitted with time to spare but stalled behind a slow
+    dispatch is shed when its deadline expires — never served late."""
+    cfg = _slo_config(deadline_ms=50.0, queue_bound_rows=0)
+    stats = EngineStats()
+    adm = AdmissionController(cfg, stats, (1, 2, 4))
+
+    def dispatch(payload, bucket, segments):
+        time.sleep(0.3)  # stall: the queued request's 50 ms expire behind it
+        return payload
+
+    mb = MicroBatcher(dispatch, (1, 2, 4), max_delay_ms=1.0, stats=stats,
+                      admission=adm)
+    outcome = {}
+
+    def blocked():
+        try:
+            outcome["result"] = mb.submit(_rows(1.0))
+        except ShedError as e:
+            outcome["error"] = e
+
+    head = threading.Thread(target=lambda: mb.submit(_rows(0.0, n=4)))
+    head.start()  # saturates -> dispatch 0 starts, worker stalls 300 ms
+    time.sleep(0.05)
+    tail = threading.Thread(target=blocked)
+    tail.start()  # queued at ~t+50ms with a 50 ms deadline
+    head.join(timeout=30)
+    tail.join(timeout=30)
+    mb.close()
+    assert "result" not in outcome
+    assert isinstance(outcome["error"], DeadlineExceeded)
+    assert "expired in queue" in str(outcome["error"])
+    assert stats.snapshot()["totals"]["shed"] == {"deadline": 1}
+
+
+def test_engine_sheds_overloaded_at_queue_bound(setup):
+    """With the worker stalled and the queue at its row bound, the next
+    arrival is shed with Overloaded at submit time — deterministically,
+    via the fault plan. Every admitted caller is still served exactly."""
+    fc, supports, ds = setup
+    cfg = _slo_config(deadline_ms=5000.0, queue_bound_rows=4)
+    plan = ServeFaultPlan(ServeFaultSpec(kind="dispatch-slow", slow_ms=400.0))
+    eng = fc.serving_engine(supports, config=cfg, fault_plan=plan)
+    try:
+        h4, h1 = _hist(fc, ds, 4), _hist(fc, ds, 1)
+        ref4, ref1 = fc.predict(supports, h4), fc.predict(supports, h1)
+        results = {}
+
+        def client(key, hist):
+            results[key] = eng.predict(hist)
+
+        head = threading.Thread(target=client, args=("head", h4))
+        head.start()  # saturates -> slow dispatch, worker busy 400 ms
+        time.sleep(0.1)
+        queued = [
+            threading.Thread(target=client, args=(i, h1)) for i in range(4)
+        ]
+        for t in queued:
+            t.start()  # fill the queue to exactly the 4-row bound
+        time.sleep(0.1)
+        with pytest.raises(Overloaded):
+            eng.predict(h1)  # bound full, worker stalled: typed shed
+        for t in [head] + queued:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in [head] + queued)  # nobody hangs
+        np.testing.assert_array_equal(results["head"], ref4)
+        for i in range(4):
+            np.testing.assert_array_equal(results[i], ref1)
+        assert eng.stats.snapshot()["totals"]["shed"]["overloaded"] == 1
+    finally:
+        eng.close()
+
+
+def test_degrade_policy_serves_shed_requests_inline(setup):
+    """shed_policy="degrade": an arrival the queue would shed is served
+    inline at degrade_rung instead — same bits, counted as degraded."""
+    fc, supports, ds = setup
+    cfg = _slo_config(deadline_ms=5000.0, queue_bound_rows=4,
+                      shed_policy="degrade", degrade_rung=1)
+    plan = ServeFaultPlan(ServeFaultSpec(kind="dispatch-slow", slow_ms=400.0))
+    eng = fc.serving_engine(supports, config=cfg, fault_plan=plan)
+    try:
+        h4, h1 = _hist(fc, ds, 4), _hist(fc, ds, 1)
+        ref1 = fc.predict(supports, h1)
+        results = {}
+
+        def client(key, hist):
+            results[key] = eng.predict(hist)
+
+        head = threading.Thread(target=client, args=("head", h4))
+        head.start()
+        time.sleep(0.1)
+        queued = [
+            threading.Thread(target=client, args=(i, h1)) for i in range(4)
+        ]
+        for t in queued:
+            t.start()
+        time.sleep(0.1)
+        t0 = time.perf_counter()
+        out = eng.predict(h1)  # shed -> served inline while worker stalls
+        assert time.perf_counter() - t0 < 0.3  # did NOT wait out the queue
+        np.testing.assert_array_equal(out, ref1)
+        for t in [head] + queued:
+            t.join(timeout=30)
+        shed = eng.stats.snapshot()["totals"]["shed"]
+        assert shed["degraded"] == 1 and shed["overloaded"] == 1
+    finally:
+        eng.close()
+
+
+def test_engine_rejects_bad_slo_config(setup):
+    fc, supports, _ = setup
+    bad = _slo_config(max_delay_ms=5.0, deadline_ms=5.0)  # at the floor
+    with pytest.raises(ValueError, match="invalid serving config"):
+        ServingEngine.from_forecaster(fc, supports, config=bad)
+
+
+# -- atomic param hot-swap ---------------------------------------------
+
+
+def _scaled_forecaster(fc, factor):
+    params = jax.tree.map(lambda a: a * factor, fc.params)
+    return params, Forecaster(
+        fc.model, params, fc.normalizer, fc.config, fc.derived,
+        getattr(fc, "normalizers", None),
+    )
+
+
+def test_swap_params_atomicity_under_concurrent_load(setup):
+    """Hammer: concurrent clients predict across three live swaps; every
+    response must be BIT-identical to the reference predictor of the
+    generation it reports — a mixed-generation result can match neither."""
+    fc, supports, ds = setup
+    eng = fc.serving_engine(supports, config=LADDER)
+    try:
+        hist = _hist(fc, ds, 2)
+        params_by_gen, expected = {0: fc.params}, {}
+        expected[0] = fc.predict(supports, hist)
+        for g in (1, 2, 3):
+            params_by_gen[g], fcg = _scaled_forecaster(fc, 1.0 + 0.01 * g)
+            expected[g] = fcg.predict(supports, hist)
+        assert not np.array_equal(expected[0], expected[1])  # teeth
+        mismatches, failures = [], []
+        stop = threading.Event()
+
+        def client():
+            try:
+                while not stop.is_set():
+                    out, gen = eng.predict(hist, with_generation=True)
+                    if not np.array_equal(out, expected[gen]):
+                        mismatches.append(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                failures.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for g in (1, 2, 3):
+            time.sleep(0.05)
+            assert eng.swap_params(params_by_gen[g]) == g
+        time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not failures
+        assert not mismatches
+        assert eng.generation == 3
+    finally:
+        eng.close()
+
+
+def test_swap_params_rejects_leaf_mismatch(setup):
+    fc, supports, _ = setup
+    eng = fc.serving_engine(supports, config=LADDER)
+    try:
+        bad = jax.tree.map(lambda a: a.astype(jnp.float16), fc.params)
+        with pytest.raises(ValueError, match="swap_params"):
+            eng.swap_params(bad)
+        assert eng.generation == 0  # rejected swap leaves params live
+    finally:
+        eng.close()
+
+
+def test_from_artifact_cannot_swap(setup, artifact, tmp_path):
+    fc, supports, _ = setup
+    with ServingEngine.from_artifact(artifact, supports, config=LADDER) as eng:
+        with pytest.raises(RuntimeError, match="from_artifact"):
+            eng.swap_params(fc.params)
+        with pytest.raises(RuntimeError, match="cannot hot-swap"):
+            eng.watch_checkpoints(str(tmp_path))
+
+
+def test_checkpoint_watcher_quarantines_then_recovers(setup, tmp_path):
+    """Mid-watch bit rot (injected at rest by the fault plan): the
+    watcher quarantines the corrupt checkpoint and keeps serving the old
+    params; the next clean write swaps in normally."""
+    fc, supports, ds = setup
+    plan = ServeFaultPlan(
+        ServeFaultSpec(kind="corrupt-checkpoint", path_glob="latest.ckpt")
+    )
+    eng = fc.serving_engine(supports, config=LADDER, fault_plan=plan)
+    try:
+        hist = _hist(fc, ds, 2)
+        ref0 = fc.predict(supports, hist)
+        new_params, fc_new = _scaled_forecaster(fc, 1.001)
+        ref1 = fc_new.predict(supports, hist)
+        watcher = eng.watch_checkpoints(str(tmp_path))
+        assert watcher.poll() is False  # nothing there yet
+        ckpt = str(tmp_path / "latest.ckpt")
+        save_checkpoint(ckpt, new_params, new_params, {"epoch": 1})
+        assert watcher.poll() is False  # corrupted at rest -> quarantined
+        assert watcher.rejected == 1 and watcher.swaps == 0
+        assert os.path.exists(ckpt + ".corrupt")
+        assert eng.generation == 0
+        np.testing.assert_array_equal(eng.predict(hist), ref0)  # old params
+        time.sleep(0.01)  # strictly newer mtime than the corrupted scan
+        save_checkpoint(ckpt, new_params, new_params, {"epoch": 1})
+        assert watcher.poll() is True  # one-shot fault spent: clean swap
+        assert watcher.swaps == 1 and watcher.last_path == ckpt
+        assert eng.generation == 1
+        out, gen = eng.predict(hist, with_generation=True)
+        assert gen == 1
+        np.testing.assert_array_equal(out, ref1)
+    finally:
+        eng.close()

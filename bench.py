@@ -131,6 +131,22 @@ _RECORD_STREAM = sys.stdout
 
 def _emit(record: dict) -> None:
     """Print the one-line JSON record and exit 0 (driver parses stdout)."""
+    try:
+        # observability rider: when STMGCN_TRACE_OUT armed the tracer (see
+        # main), export the timeline and fold the JAX telemetry into the
+        # record. best-effort — obs must never cost the run its record.
+        from stmgcn_tpu.obs import jaxmon
+        from stmgcn_tpu.obs import trace as obs_trace
+
+        trc = obs_trace.active_tracer()
+        if trc is not None or jaxmon.installed():
+            record["obs"] = jaxmon.snapshot()
+            path = os.environ.get("STMGCN_TRACE_OUT")
+            if trc is not None and path:
+                record["obs"]["trace_path"] = path
+                record["obs"]["trace_spans"] = trc.export_jsonl(path)
+    except Exception as e:  # noqa: BLE001 — never block the record line
+        print(f"bench: obs rider failed: {e}", file=sys.stderr)
     print(json.dumps(record), file=_RECORD_STREAM, flush=True)
     sys.exit(0)
 
@@ -749,6 +765,17 @@ def main() -> None:
         )
     from stmgcn_tpu.utils import force_host_platform
     from stmgcn_tpu.utils.hostload import measurement_preamble
+
+    if os.environ.get("STMGCN_TRACE_OUT"):
+        # STMGCN_TRACE_OUT (deliberately not STMGCN_BENCH_*: tracing does
+        # not move the operating point, and we prove <=2% overhead) arms
+        # the span ring + jax.monitoring before the first compile; _emit
+        # exports the timeline and adds record["obs"]
+        from stmgcn_tpu.obs import jaxmon
+        from stmgcn_tpu.obs import trace as obs_trace
+
+        obs_trace.configure()
+        jaxmon.install()
 
     # Serialize against the tunnel-probe loop (and any other bench) before
     # measuring anything: on this 1-core host the competing process IS the

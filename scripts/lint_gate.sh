@@ -1,14 +1,19 @@
 #!/usr/bin/env bash
 # CI lint gate: stmgcn lint (whole-program + contracts) plus ruff when
-# the image ships it. Stdout is the contract — EXACTLY one JSON line:
+# the image ships it, plus a traced smoke-training run that must report
+# ZERO JAX recompiles after warmup (the dynamic counterpart of the
+# static recompile-hazard rule). Stdout is the contract — EXACTLY one
+# JSON line:
 #
 #   {"gate": "PASS"|"FAIL", "lint": {"exit": N, "errors": N,
 #    "warnings": N, "version": N}, "ruff": {"available": true|false,
-#    "exit": N|null}}
+#    "exit": N|null}, "obs": {"exit": N, "recompiles_after_warmup":
+#    N|null, "trace_spans": N|null}}
 #
 # Everything human-readable (full reports, ruff listing) goes to stderr.
 # Exit 0 iff the gate is PASS: lint found no unsuppressed errors AND
-# ruff (when available) is clean. The stdout shape is pinned by a
+# ruff (when available) is clean AND the traced smoke run compiled
+# nothing after its warmup mark. The stdout shape is pinned by a
 # slow-tier test (tests/test_analysis.py::TestLintGateScript).
 set -u -o pipefail
 
@@ -28,8 +33,52 @@ if command -v ruff >/dev/null 2>&1; then
     ruff_exit=$?
 fi
 
+# Traced smoke run: tiny resident-superstep training with the span
+# tracer + jax.monitoring listener armed; after warmup (first epoch)
+# every compile is a runtime recompile and fails the gate.
+obs_json=$(JAX_PLATFORMS=cpu "$PY" - <<'EOF' 2>>/dev/stderr
+import json
+import os
+import tempfile
+
+from stmgcn_tpu.obs import jaxmon
+from stmgcn_tpu.obs import trace as obs_trace
+
+obs_trace.configure()
+jaxmon.install()
+
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.experiment import build_trainer
+
+with tempfile.TemporaryDirectory(prefix="stmgcn_gate_") as tmp:
+    cfg = preset("smoke")
+    cfg.data.rows = 5
+    cfg.data.n_timesteps = 24 * 7 * 2 + 60
+    cfg.train.epochs = 2
+    cfg.train.batch_size = 8
+    cfg.train.data_placement = "resident"
+    cfg.train.steps_per_superstep = 2
+    cfg.train.out_dir = tmp
+    trainer = build_trainer(cfg, verbose=False)
+    trainer.train()
+    trainer.flush_checkpoints()
+    n_spans = obs_trace.active_tracer().export_jsonl(
+        os.path.join(tmp, "trace.jsonl")
+    )
+snap = jaxmon.snapshot()
+print(json.dumps({
+    "recompiles_after_warmup": snap["recompiles_after_warmup"],
+    "compilations": snap["compilations"],
+    "trace_spans": n_spans,
+}))
+EOF
+)
+obs_exit=$?
+printf '%s\n' "$obs_json" >&2
+
 LINT_JSON="$lint_json" LINT_EXIT="$lint_exit" \
 RUFF_AVAILABLE="$ruff_available" RUFF_EXIT="$ruff_exit" \
+OBS_JSON="$obs_json" OBS_EXIT="$obs_exit" \
 "$PY" - <<'EOF'
 import json
 import os
@@ -42,10 +91,17 @@ except ValueError:
 lint_exit = int(os.environ["LINT_EXIT"])
 ruff_available = os.environ["RUFF_AVAILABLE"] == "true"
 ruff_exit = None if os.environ["RUFF_EXIT"] == "null" else int(os.environ["RUFF_EXIT"])
+try:
+    obs = json.loads(os.environ["OBS_JSON"])
+except ValueError:
+    obs = {}
+obs_exit = int(os.environ["OBS_EXIT"])
+recompiles = obs.get("recompiles_after_warmup")
 
 ok = lint_exit == 0 and report.get("errors") == 0
 if ruff_available:
     ok = ok and ruff_exit == 0
+ok = ok and obs_exit == 0 and recompiles == 0
 print(json.dumps({
     "gate": "PASS" if ok else "FAIL",
     "lint": {
@@ -55,6 +111,11 @@ print(json.dumps({
         "version": report.get("version"),
     },
     "ruff": {"available": ruff_available, "exit": ruff_exit},
+    "obs": {
+        "exit": obs_exit,
+        "recompiles_after_warmup": recompiles,
+        "trace_spans": obs.get("trace_spans"),
+    },
 }))
 sys.exit(0 if ok else 1)
 EOF

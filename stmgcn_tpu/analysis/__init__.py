@@ -36,9 +36,11 @@ by tier-1 (``tests/test_analysis.py``):
   materialized-window footprint vs the per-core budget,
   :mod:`.resident_check`), fleet shape-class math for every preset that
   engages the fleet path (planner knobs, city coverage, per-class
-  resident footprint, :mod:`.fleet_check`), and serving bucket-ladder
+  resident footprint, :mod:`.fleet_check`), serving bucket-ladder
   math for every preset (strictly increasing, covers max_batch, pad
-  waste bounded), and static Pallas kernel checks (:mod:`.pallas_check`):
+  waste bounded), observability budget math for every preset (span-ring
+  and histogram-reservoir bounds, :mod:`.obs_check`), and static Pallas
+  kernel checks (:mod:`.pallas_check`):
   grid/BlockSpec divisibility plus a calibrated VMEM-footprint estimate
   for every ``pl.pallas_call`` site in :mod:`stmgcn_tpu.ops.pallas_lstm`,
   reproducing the known 18.04 MB fp32-forward Mosaic OOM from source
@@ -52,6 +54,7 @@ from stmgcn_tpu.analysis.collective_check import check_collective_contracts
 from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
+from stmgcn_tpu.analysis.obs_check import check_obs_overhead
 from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
 from stmgcn_tpu.analysis.program_db import ProgramDB
 from stmgcn_tpu.analysis.report import Finding, render_json, render_text
@@ -70,6 +73,7 @@ __all__ = [
     "Rule",
     "check_collective_contracts",
     "check_fleet_shape_classes",
+    "check_obs_overhead",
     "check_pallas_kernels",
     "check_partition_specs",
     "check_resident_memory",

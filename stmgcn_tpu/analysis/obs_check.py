@@ -1,0 +1,55 @@
+"""Pass 2h: obs-overhead contracts — observability config budget math.
+
+The observability layer must never become the thing it measures: a
+preset that turns span tracing on with an unbounded ring, or sizes a
+histogram reservoir past the documented budget, regresses a long-lived
+process in exactly the way the old unbounded ``EngineStats`` lists did.
+The budgets (``config.OBS_RING_BUDGET`` / ``OBS_RESERVOIR_BUDGET``) and
+the per-config arithmetic (``ObsConfig.violations()``) live next to the
+other config contracts; this pass evaluates them per preset. Pure
+config math — no tracer, no JAX.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_obs_overhead"]
+
+
+def check_obs_overhead(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+) -> List[Finding]:
+    """Validate every preset's observability knobs against the budgets.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset. One finding per violation string.
+    """
+    from stmgcn_tpu.config import PRESETS
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="obs-overhead",
+                path=f"<contract:obs:{name}>",
+                line=0,
+                message=message,
+                severity=RULES["obs-overhead"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        obs = getattr(cfg, "obs", None)
+        if obs is None:
+            continue
+        for violation in obs.violations():
+            emit(name, f"{name}: {violation}")
+    return findings

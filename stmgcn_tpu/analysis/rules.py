@@ -139,6 +139,15 @@ _ALL_RULES = [
         "math",
     ),
     Rule(
+        "obs-overhead",
+        "error",
+        "a preset enables tracing with an unbounded span ring or "
+        "configures a histogram reservoir past the documented budget "
+        "(config.OBS_RING_BUDGET / OBS_RESERVOIR_BUDGET) — observability "
+        "itself becomes the memory leak / perf regression in a "
+        "long-lived process",
+    ),
+    Rule(
         "pallas-blockspec",
         "error",
         "a pl.pallas_call BlockSpec/grid disagrees with its operand "

@@ -14,6 +14,7 @@ Usage::
     python -m stmgcn_tpu.cli --preset default --test-only --out-dir output
     python -m stmgcn_tpu.cli lint --format json   # static analysis gate
     python -m stmgcn_tpu.cli serve-bench          # serving-engine benchmark
+    python -m stmgcn_tpu.cli obs trace.jsonl      # span-trace report
 """
 
 from __future__ import annotations
@@ -174,6 +175,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "costs a device sync per step")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR")
+    p.add_argument("--trace-out", type=str, default=None, metavar="PATH",
+                   help="record wall-clock spans (host pack / upload / "
+                        "device superstep / checkpoint) plus JAX compile "
+                        "telemetry and write the schema-versioned JSONL "
+                        "timeline to PATH; inspect with `stmgcn obs PATH`")
     p.add_argument("--resume", nargs="?", const="strict", default=None,
                    choices=("strict", "auto"),
                    help="resume before training from the newest *verified* "
@@ -307,6 +313,11 @@ def main(argv=None) -> int:
         from stmgcn_tpu.serving.bench import main as serve_bench_main
 
         return serve_bench_main(argv[1:])
+    if argv and argv[0] == "obs":
+        # span-trace report: pure stdlib, no JAX backend initialization
+        from stmgcn_tpu.obs.cli import main as obs_main
+
+        return obs_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.print_config:
@@ -333,6 +344,16 @@ def main(argv=None) -> int:
         from stmgcn_tpu.parallel import init_distributed
 
         init_distributed()
+    if args.trace_out:
+        # after platform forcing (no backend op has run), before the first
+        # compile — so the jax.monitoring listener sees every compilation
+        from stmgcn_tpu.obs import jaxmon
+        from stmgcn_tpu.obs import trace as obs_trace
+
+        cfg.obs.trace = True
+        cfg.obs.trace_path = args.trace_out
+        obs_trace.configure(capacity=cfg.obs.ring_capacity)
+        jaxmon.install()
 
     from stmgcn_tpu.experiment import build_trainer  # defer heavy imports
 
@@ -386,6 +407,21 @@ def main(argv=None) -> int:
 
     if jax.process_index() == 0:  # one JSON line per job, not per host
         print(json.dumps({"preset": cfg.name, "results": results}))
+    if args.trace_out and jax.process_index() == 0:
+        from stmgcn_tpu.obs import jaxmon
+        from stmgcn_tpu.obs import trace as obs_trace
+
+        trc = obs_trace.active_tracer()
+        if trc is not None:
+            n = trc.export_jsonl(args.trace_out)
+            mon = jaxmon.snapshot()
+            print(
+                f"trace written to {args.trace_out} ({n} spans, "
+                f"{mon['compilations']} compilations, "
+                f"{mon['recompiles_after_warmup']} recompiles after warmup)"
+                " — inspect with `stmgcn obs " + args.trace_out + "`",
+                file=sys.stderr,
+            )
 
     # Export last: a failed export must not cost the run its results line.
     if args.export:

@@ -24,6 +24,9 @@ __all__ = [
     "ExperimentConfig",
     "MeshConfig",
     "ModelConfig",
+    "OBS_RESERVOIR_BUDGET",
+    "OBS_RING_BUDGET",
+    "ObsConfig",
     "PRESETS",
     "ServingConfig",
     "TrainConfig",
@@ -31,6 +34,14 @@ __all__ = [
 ]
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+#: documented observability memory budgets (README "Observability"):
+#: largest span ring / histogram reservoir a preset may configure. At
+#: ~200 B per span record and ~8 B per sample these bound a fully-loaded
+#: process to ~13 MB of trace ring and 64 KiB per histogram — the
+#: ``obs-overhead`` lint rule fails any preset configured past them.
+OBS_RING_BUDGET = 65536
+OBS_RESERVOIR_BUDGET = 8192
 
 
 @dataclasses.dataclass
@@ -414,6 +425,64 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """Runtime observability knobs (:mod:`stmgcn_tpu.obs`).
+
+    Off by default — the disabled path must cost nothing on the hot
+    loops. ``violations()`` is the pure-config contract behind the
+    ``obs-overhead`` lint rule: a preset that turns tracing on with an
+    unbounded ring or an over-budget reservoir is a silent memory/perf
+    regression waiting for a long run, so it fails ``stmgcn lint``
+    before it fails a soak.
+    """
+
+    #: record spans into the ring buffer (``--trace-out`` enables this)
+    trace: bool = False
+    #: JSONL export destination; None keeps the ring in-process only
+    trace_path: Optional[str] = None
+    #: span ring capacity; oldest spans evicted when full. Must be a
+    #: positive bound within :data:`OBS_RING_BUDGET`
+    ring_capacity: int = 4096
+    #: bounded-histogram sample window (EngineStats percentiles etc.);
+    #: must be positive and within :data:`OBS_RESERVOIR_BUDGET`
+    reservoir: int = 1024
+
+    def violations(self) -> list:
+        """Every way this config breaks the documented overhead budget
+        (empty list = valid; the ``obs-overhead`` rule). Reservoir
+        bounds always apply — EngineStats histograms exist with tracing
+        off; the ring bounds only matter once tracing allocates one.
+        """
+        v = []
+        if self.reservoir < 1:
+            v.append(
+                f"reservoir must be >= 1, got {self.reservoir} — "
+                "histograms need a positive sample bound"
+            )
+        elif self.reservoir > OBS_RESERVOIR_BUDGET:
+            v.append(
+                f"reservoir {self.reservoir} exceeds the documented "
+                f"budget {OBS_RESERVOIR_BUDGET} — percentile windows "
+                "past the budget buy no accuracy, only memory"
+            )
+        if not self.trace:
+            return v
+        if self.ring_capacity < 1:
+            v.append(
+                f"ring_capacity must be >= 1 when tracing, got "
+                f"{self.ring_capacity} — an unbounded span buffer grows "
+                "without limit in a long-lived process"
+            )
+        elif self.ring_capacity > OBS_RING_BUDGET:
+            v.append(
+                f"ring_capacity {self.ring_capacity} exceeds the "
+                f"documented budget {OBS_RING_BUDGET} — export the "
+                "trace and rotate instead of growing the ring"
+            )
+        return v
+
+
+@dataclasses.dataclass
 class ExperimentConfig:
     name: str = "default"
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
@@ -421,6 +490,7 @@ class ExperimentConfig:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -434,6 +504,7 @@ class ExperimentConfig:
             train=TrainConfig(**d.get("train", {})),
             mesh=MeshConfig(**d.get("mesh", {})),
             serving=ServingConfig(**d.get("serving", {})),
+            obs=ObsConfig(**d.get("obs", {})),
         )
 
 

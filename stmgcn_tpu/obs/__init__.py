@@ -1,0 +1,55 @@
+"""Unified runtime observability: span tracing, the process-wide
+metrics registry, and JAX compile/transfer telemetry.
+
+Stdlib-only at import time (jax loads lazily inside
+:func:`jaxmon.install` and :meth:`trace.Span.fence`), off by default,
+and free when off: hot loops hoist :func:`active_tracer` and skip every
+obs call when it returns ``None``.
+"""
+
+from .registry import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    REGISTRY,
+    Reservoir,
+    registry,
+)
+from .trace import (
+    SCHEMA_VERSION,
+    Span,
+    Tracer,
+    active_tracer,
+    configure,
+    enabled,
+    span,
+)
+from .jaxmon import (
+    install,
+    installed,
+    mark_warmup_complete,
+    record_upload,
+)
+from . import jaxmon, report
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "REGISTRY",
+    "Reservoir",
+    "SCHEMA_VERSION",
+    "Span",
+    "Tracer",
+    "active_tracer",
+    "configure",
+    "enabled",
+    "install",
+    "installed",
+    "jaxmon",
+    "mark_warmup_complete",
+    "record_upload",
+    "registry",
+    "report",
+    "span",
+]

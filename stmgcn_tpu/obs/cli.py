@@ -1,0 +1,66 @@
+"""``stmgcn obs`` — dump/summarize an exported JSONL trace.
+
+Text mode renders the per-phase table; ``--format json`` prints exactly
+one JSON line on stdout (machine contract, same discipline as the bench
+CLIs) with the summary, meta header, and — with ``--dump`` — the raw
+spans.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .report import load_trace, render_table, summarize
+
+__all__ = ["build_obs_parser", "main"]
+
+
+def build_obs_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="stmgcn obs",
+        description="Summarize a JSONL span trace (see README Observability).",
+    )
+    p.add_argument("trace", help="path to a --trace-out JSONL file")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="text table or one JSON line on stdout")
+    p.add_argument("--dump", action="store_true",
+                   help="include raw spans (json) / print them (text)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_obs_parser().parse_args(argv)
+    try:
+        meta, spans = load_trace(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"obs: cannot read trace: {e}", file=sys.stderr)
+        return 2
+
+    summary = summarize(spans)
+    try:
+        if args.format == "json":
+            out = {"meta": meta, "summary": summary}
+            if args.dump:
+                out["spans"] = spans
+            sys.stdout.write(json.dumps(out, sort_keys=True) + "\n")
+            return 0
+
+        print(render_table(summary, meta))
+        if args.dump:
+            for s in spans:
+                print(json.dumps(s, sort_keys=True))
+    except BrokenPipeError:
+        # `stmgcn obs trace | head` closing the pipe early is fine; don't
+        # let the teardown flush traceback either
+        try:
+            sys.stdout.close()
+        except BrokenPipeError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

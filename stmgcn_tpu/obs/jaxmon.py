@@ -1,0 +1,149 @@
+"""JAX runtime telemetry via ``jax.monitoring`` listeners.
+
+Counts backend compilations as they happen and exposes a
+``jax.recompiles_after_warmup`` gauge: after the caller declares warmup
+complete (:func:`mark_warmup_complete`, e.g. at the end of the first
+training epoch, once every jitted program has been traced), any further
+compile is a *runtime* recompile alarm — the dynamic counterpart of the
+static ``recompile-hazard`` lint rule, catching shape/dtype drift the
+AST pass cannot see. ``scripts/lint_gate.sh`` fails the gate when a
+traced smoke run reports a nonzero value.
+
+Host→device transfer telemetry: jax 0.4.x emits no transfer events on
+the CPU/tunneled backends, so upload accounting is done at the
+instrumentation sites instead — the trainer's double-buffered ``place``
+and the serving upload paths call :func:`record_upload` with the array
+byte counts they just moved, giving the measured upload-bytes-per-step
+number the window-free path claims.
+
+All counters live in the shared :data:`~stmgcn_tpu.obs.registry.REGISTRY`.
+jax is imported inside :func:`install` only — module scope stays
+stdlib-only, and installing is idempotent (``jax.monitoring`` has no
+per-listener unregister, so a second install must be a no-op).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import REGISTRY
+
+__all__ = [
+    "freeze_recompiles",
+    "install",
+    "installed",
+    "mark_warmup_complete",
+    "record_upload",
+    "snapshot",
+]
+
+#: the duration event jax 0.4.x emits once per backend (XLA) compile
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_INSTALLED = False
+
+
+def install() -> bool:
+    """Register the monitoring listeners (idempotent). Returns True if
+    listeners are active after the call, False when the running jax has
+    no ``jax.monitoring`` (older/stubbed builds) — callers degrade to
+    zero-valued counters rather than failing."""
+    global _INSTALLED
+    if _INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+
+    compiles = REGISTRY.counter("jax.compilations")
+    compile_ms = REGISTRY.counter("jax.compile_ms")
+    events = REGISTRY.counter("jax.monitoring_events")
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if event == _COMPILE_EVENT:
+            compiles.inc()
+            compile_ms.inc(duration * 1e3)
+
+    def _on_event(event: str, **kwargs) -> None:
+        events.inc()
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+    _INSTALLED = True
+    return True
+
+
+def installed() -> bool:
+    return _INSTALLED
+
+
+#: recompile count pinned by :func:`freeze_recompiles`; None = live
+_FROZEN: Optional[float] = None
+
+
+def mark_warmup_complete() -> float:
+    """Snapshot the compile count as the warmup baseline. Every compile
+    after this point shows up in the ``jax.recompiles_after_warmup``
+    gauge (refreshed by :func:`snapshot`). Returns the baseline.
+    Re-marking re-baselines and unfreezes the gauge."""
+    global _FROZEN
+    _FROZEN = None
+    baseline = REGISTRY.counter("jax.compilations").value
+    REGISTRY.gauge("jax.warmup_compilations").set(baseline)
+    REGISTRY.gauge("jax.warmup_marked").set(1.0)
+    REGISTRY.gauge("jax.recompiles_after_warmup").set(0.0)
+    return baseline
+
+
+def freeze_recompiles() -> float:
+    """Pin ``jax.recompiles_after_warmup`` at its current value.
+
+    Called when the warmed steady-state loop *ends* (the trainer calls it
+    on entering the test phase): later first-touch compiles — evaluation
+    over a split the training loop never gathered from, export tracing —
+    are expected new programs, not recompiles of the warmed loop, and
+    must not trip the gate. Returns the pinned value; a later
+    :func:`mark_warmup_complete` unfreezes."""
+    global _FROZEN
+    _FROZEN = _refresh_recompiles()
+    return _FROZEN
+
+
+def record_upload(nbytes: int, n: int = 1) -> None:
+    """Account a host→device transfer done at an instrumentation site."""
+    REGISTRY.counter("jax.upload_bytes").inc(nbytes)
+    REGISTRY.counter("jax.uploads").inc(n)
+
+
+def _refresh_recompiles() -> float:
+    if _FROZEN is not None:
+        return _FROZEN
+    compiles = REGISTRY.counter("jax.compilations").value
+    if REGISTRY.gauge("jax.warmup_marked").value:
+        baseline = REGISTRY.gauge("jax.warmup_compilations").value
+        recompiles = max(0.0, compiles - baseline)
+    else:
+        recompiles = 0.0
+    REGISTRY.gauge("jax.recompiles_after_warmup").set(recompiles)
+    return recompiles
+
+
+def snapshot(steps: Optional[int] = None) -> dict:
+    """Current telemetry as a plain dict (bench records, gate checks).
+
+    ``steps`` adds the per-step upload rate when the caller knows how
+    many hot-loop steps the counters cover.
+    """
+    recompiles = _refresh_recompiles()
+    out = {
+        "installed": _INSTALLED,
+        "compilations": int(REGISTRY.counter("jax.compilations").value),
+        "compile_ms": round(REGISTRY.counter("jax.compile_ms").value, 3),
+        "recompiles_after_warmup": int(recompiles),
+        "upload_bytes": int(REGISTRY.counter("jax.upload_bytes").value),
+        "uploads": int(REGISTRY.counter("jax.uploads").value),
+    }
+    if steps:
+        out["upload_bytes_per_step"] = round(out["upload_bytes"] / steps, 1)
+    return out

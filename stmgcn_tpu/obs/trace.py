@@ -1,0 +1,262 @@
+"""Nested wall-clock span tracing into a bounded ring buffer.
+
+Spans are plain host-side timers — ``with obs.span("superstep", step=i):``
+— nested via a per-thread stack, recorded into a thread-safe ring
+(oldest spans evicted, never unbounded growth) and exported as
+schema-versioned JSONL. Device-side work needs one extra step on the
+tunneled backend: a dispatch returns as soon as the program is enqueued,
+so a span that closes at the Python ``return`` measures enqueue latency,
+not device time. :meth:`Span.fence` closes the span through the readback
+fence in :mod:`stmgcn_tpu.utils.profiling` (block + one-element
+device_get), which is the only honest device-completion edge we have.
+
+The tracer is process-global and off by default. The disabled path is
+the whole point of the design: hot loops ask :func:`active_tracer` once
+per batch and skip every obs call when it returns ``None``, so tracing
+adds **zero per-step allocations** when disabled (context managers and
+kwargs both allocate at the call site, which is why the hot paths use
+the ``tracer.record_span(name, t0, t1)`` retroactive form instead).
+
+Module scope is stdlib-only; jax is imported lazily inside
+:meth:`Span.fence` so importing :mod:`stmgcn_tpu.obs` never pulls jax.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "Span",
+    "Tracer",
+    "active_tracer",
+    "configure",
+    "enabled",
+    "span",
+]
+
+#: bump when the JSONL span record shape changes; pinned by the slow-tier
+#: trace-schema contract test
+SCHEMA_VERSION = 1
+
+#: default ring capacity; within the OBS_RING_BUDGET the obs-overhead
+#: rule enforces for preset configs
+DEFAULT_RING = 4096
+
+
+class Span:
+    """One open span. Close with :meth:`end` (host work) or
+    :meth:`fence` (device work); both are idempotent-ish in the sense
+    that only the first close records."""
+
+    __slots__ = ("tracer", "name", "attrs", "id", "parent", "depth", "t0",
+                 "_open")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 attrs: Optional[Dict[str, Any]],
+                 span_id: int, parent: int, depth: int):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.id = span_id
+        self.parent = parent
+        self.depth = depth
+        self.t0 = time.perf_counter()
+        self._open = True
+
+    def end(self) -> None:
+        if not self._open:
+            return
+        self._open = False
+        self.tracer._close(self, time.perf_counter())
+
+    def fence(self, tree) -> None:
+        """Block until ``tree``'s device work is done, then close.
+
+        Tolerates trees with no array leaves (the fence raises
+        ValueError there) by falling back to a plain :meth:`end` —
+        an instrumentation span must never take down the run.
+        """
+        try:
+            from stmgcn_tpu.utils.profiling import fence as _fence
+            _fence(tree)
+        except ValueError:
+            pass
+        self.end()
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end()
+
+
+class _NoopSpan:
+    """Stateless stand-in returned by :func:`span` when tracing is off.
+    A single shared instance: no per-call allocation on the casual-use
+    path (hot loops skip even this via :func:`active_tracer`)."""
+
+    __slots__ = ()
+
+    def end(self) -> None:
+        pass
+
+    def fence(self, tree) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Bounded thread-safe span recorder.
+
+    Closed spans land in a ring of at most ``capacity`` records; when
+    full, the oldest are evicted and :attr:`dropped` counts them, so a
+    long run degrades to "most recent window" instead of OOM. Span
+    nesting (parent/depth) is tracked per thread.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_RING):
+        if capacity < 1:
+            raise ValueError(f"ring capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.dropped = 0
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._next_id = 1
+        self._t_origin = time.perf_counter()
+
+    # -- span lifecycle ------------------------------------------------
+
+    def _stack(self) -> List[int]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def span(self, name: str, **attrs: Any) -> Span:
+        stack = self._stack()
+        parent = stack[-1] if stack else 0
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+        sp = Span(self, name, attrs or None, span_id, parent, len(stack))
+        stack.append(span_id)
+        return sp
+
+    def _close(self, sp: Span, t1: float) -> None:
+        stack = self._stack()
+        # unwind to this span; unbalanced closes (exception paths) drop
+        # the abandoned children from the stack, not the ring
+        while stack and stack[-1] != sp.id:
+            stack.pop()
+        if stack:
+            stack.pop()
+        self._record(sp.name, sp.t0, t1, sp.id, sp.parent, sp.depth, sp.attrs)
+
+    def record_span(self, name: str, t0: float, t1: float,
+                    attrs: Optional[Dict[str, Any]] = None) -> None:
+        """Retroactive span from two ``perf_counter`` readings.
+
+        The hot-loop form: the caller times with locals and reports
+        after the fact, so the disabled path is a single ``is not None``
+        check with no Span object, no kwargs dict, no context manager.
+        Recorded at the current thread's nesting level.
+        """
+        stack = self._stack()
+        parent = stack[-1] if stack else 0
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+        self._record(name, t0, t1, span_id, parent, len(stack), attrs)
+
+    def _record(self, name: str, t0: float, t1: float, span_id: int,
+                parent: int, depth: int,
+                attrs: Optional[Dict[str, Any]]) -> None:
+        rec = {
+            "schema_version": SCHEMA_VERSION,
+            "id": span_id,
+            "parent": parent,
+            "depth": depth,
+            "name": name,
+            "ts": round((t0 - self._t_origin) * 1e3, 3),
+            "dur_ms": round((t1 - t0) * 1e3, 3),
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append(rec)
+
+    # -- export --------------------------------------------------------
+
+    def spans(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def export_jsonl(self, path: str) -> int:
+        """Write the ring as JSONL: a ``meta`` header line then one
+        JSON object per span. Returns the number of spans written."""
+        spans = self.spans()
+        meta = {
+            "schema_version": SCHEMA_VERSION,
+            "kind": "meta",
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "spans": len(spans),
+        }
+        with open(path, "w") as f:
+            f.write(json.dumps(meta, sort_keys=True) + "\n")
+            for rec in spans:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return len(spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+
+# -- process-global switch ---------------------------------------------
+
+_TRACER: Optional[Tracer] = None
+
+
+def configure(enable: bool = True, capacity: int = DEFAULT_RING) -> Optional[Tracer]:
+    """Turn tracing on (fresh :class:`Tracer`) or off (``None``)."""
+    global _TRACER
+    _TRACER = Tracer(capacity) if enable else None
+    return _TRACER
+
+
+def active_tracer() -> Optional[Tracer]:
+    """The hot-loop gate: hoist ``trc = active_tracer()`` out of the
+    loop and guard every obs call with ``if trc is not None``."""
+    return _TRACER
+
+
+def enabled() -> bool:
+    return _TRACER is not None
+
+
+def span(name: str, **attrs: Any):
+    """Convenience for cool paths: a real span when tracing is on, the
+    shared no-op otherwise. (Kwargs still allocate here — hot loops use
+    :func:`active_tracer` + ``record_span`` instead.)"""
+    trc = _TRACER
+    if trc is None:
+        return _NOOP_SPAN
+    return trc.span(name, **attrs)

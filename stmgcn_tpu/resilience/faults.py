@@ -39,6 +39,8 @@ import os
 import signal
 from typing import Optional, Tuple
 
+from stmgcn_tpu.obs.registry import REGISTRY
+
 __all__ = [
     "BatcherKilled",
     "FaultPlan",
@@ -60,6 +62,12 @@ SERVE_KINDS = (
     "batcher-die",
     "corrupt-checkpoint",
 )
+
+
+def _count_fault(kind: str) -> None:
+    """Registry tally of faults that actually FIRED (never armed specs —
+    the empty-plan hooks short-circuit before reaching this)."""
+    REGISTRY.counter("faults.injected", {"kind": kind}).inc()
 
 
 class InjectedFault(RuntimeError):
@@ -179,6 +187,7 @@ class FaultPlan:
             if key in self._fired or not spec._matches_step(epoch, start, stop):
                 continue
             self._fired.add(key)
+            _count_fault(spec.kind)
             if spec.kind == "sigterm":
                 signal.raise_signal(signal.SIGTERM)
             else:
@@ -195,15 +204,19 @@ class FaultPlan:
         """
         for spec in self.specs:
             if spec.kind == "poison" and spec._matches_step(epoch, step, step + 1):
+                _count_fault("poison")
                 return spec.payload
         return None
 
     def should_drop(self, epoch: int, step: int) -> bool:
         """Whether this batch is consumed without an optimizer step."""
-        return any(
+        hit = any(
             spec.kind == "drop" and spec._matches_step(epoch, step, step + 1)
             for spec in self.specs
         )
+        if hit:
+            _count_fault("drop")
+        return hit
 
     def any_drop(self, epoch: int, start: int, stop: int) -> bool:
         """Whether any ordinal in ``[start, stop)`` carries a drop fault —
@@ -231,6 +244,7 @@ class FaultPlan:
             if count != spec.write_index or key in self._fired:
                 continue
             self._fired.add(key)
+            _count_fault(spec.kind)
             if spec.kind == "truncate-write":
                 data = data[: max(1, int(len(data) * spec.keep_fraction))]
             else:
@@ -333,14 +347,17 @@ class ServeFaultPlan:
             if not spec._matches_dispatch(ordinal):
                 continue
             if spec.kind == "dispatch-slow":
+                _count_fault("dispatch-slow")
                 time.sleep(spec.slow_ms / 1e3)
             elif spec.kind == "dispatch-hang":
+                _count_fault("dispatch-hang")
                 time.sleep(spec.hang_ms / 1e3)
             elif spec.kind in ("dispatch-raise", "batcher-die"):
                 key = ("dispatch", i)
                 if key in self._fired:
                     continue
                 self._fired.add(key)
+                _count_fault(spec.kind)
                 if spec.kind == "batcher-die":
                     raise BatcherKilled(
                         f"injected batcher death at dispatch {ordinal}"
@@ -387,6 +404,7 @@ class ServeFaultPlan:
                 except OSError:
                     continue
                 self._fired.add(key)
+                _count_fault("corrupt-checkpoint")
                 hit.append(path)
                 break
         return hit

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from stmgcn_tpu.obs.registry import REGISTRY
+
 __all__ = ["DivergenceError", "DivergenceGuard"]
 
 ACTIONS = ("skip", "defer")
@@ -72,6 +74,7 @@ class DivergenceGuard:
         """
         self.consecutive += 1
         self.total += 1
+        REGISTRY.counter("train.divergence_trips").inc()
         if self.consecutive >= self.patience:
             raise DivergenceError(
                 f"{self.consecutive} consecutive non-finite losses "

@@ -494,6 +494,8 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
 
     from stmgcn_tpu.config import ServingConfig
     from stmgcn_tpu.inference import Forecaster
+    from stmgcn_tpu.obs import jaxmon
+    from stmgcn_tpu.obs.registry import REGISTRY
     from stmgcn_tpu.serving.admission import DeadlineExceeded, Overloaded
     from stmgcn_tpu.serving.engine import ServingEngine
     from stmgcn_tpu.utils.hostload import host_load_snapshot, is_contended
@@ -553,6 +555,7 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
     barrier = threading.Barrier(clients + 1)
     t_start = [0.0]
 
+    swaps_before = REGISTRY.counter("serving.swaps").value
     engine = ServingEngine.from_forecaster(fc, supports, config=cfg)
     try:
         base = fc.predict(supports, h_req)
@@ -563,6 +566,12 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
             fc.model, new_params, fc.normalizer, fc.config, fc.derived,
             getattr(fc, "normalizers", None),
         )
+        if jaxmon.installed():
+            # engine bucket programs are AOT-built and probed, and the
+            # swap payload is materialized: any compile DURING the soak
+            # (including across the hot-swap) is a serving incident the
+            # gauge must surface
+            jaxmon.mark_warmup_complete()
 
         def client(i: int):
             my_admitted, my_gens = [], {}
@@ -619,6 +628,9 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
             th.join(timeout=max(0.0, deadline_join - time.monotonic()))
         hung = sum(th.is_alive() for th in threads)
         swapper.join()
+        recompiles_soak = (
+            int(jaxmon.freeze_recompiles()) if jaxmon.installed() else None
+        )
         # generation-1 parity after the dust settles: the engine now
         # serves the swapped params and must match a Forecaster built
         # from them bit-exactly
@@ -628,6 +640,19 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
         )
         stats = engine.stats.snapshot()
         generation_after = engine.generation
+        # shed/degrade/swap counts read back from the process-wide
+        # metrics registry (stmgcn_tpu.obs.registry) — the same counters
+        # a metrics endpoint would scrape, cross-checkable against the
+        # client-side tallies above
+        registry_counts = {
+            "shed": engine.stats.shed_counts(),
+            "swaps": int(
+                REGISTRY.counter("serving.swaps").value - swaps_before
+            ),
+            "generation": int(REGISTRY.gauge("serving.generation").value),
+        }
+        if recompiles_soak is not None:
+            registry_counts["recompiles_during_soak"] = recompiles_soak
     finally:
         engine.close()
     load_after = host_load_snapshot()
@@ -654,6 +679,7 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
         "admitted": len(admitted_ms),
         "shed": shed_local,
         "shed_recorded": stats["totals"]["shed"],
+        "registry": registry_counts,
         "behind_schedule": behind_schedule[0],
         "admitted_latency_ms": pct,
         "slo_target_ms": round(slo_target_ms, 3),
@@ -718,6 +744,11 @@ def build_serve_bench_parser() -> argparse.ArgumentParser:
     p.add_argument("--soak-overload", type=float, default=2.0,
                    help="offered load as a multiple of calibrated capacity "
                         "(default 2.0)")
+    p.add_argument("--trace-out", type=str, default=None, metavar="PATH",
+                   help="record per-request spans (admit -> queue -> "
+                        "device -> scatter, generation-stamped) plus JAX "
+                        "compile telemetry; writes the JSONL timeline to "
+                        "PATH and adds record['obs']")
     return p
 
 
@@ -726,6 +757,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     everything else — training chatter, compile logs — goes to stderr."""
     args = build_serve_bench_parser().parse_args(argv)
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    if args.trace_out:
+        from stmgcn_tpu.obs import jaxmon
+        from stmgcn_tpu.obs import trace as obs_trace
+
+        obs_trace.configure()
+        jaxmon.install()
 
     record_stream = sys.stdout
     sys.stdout = sys.stderr  # anything a dependency prints stays off-record
@@ -734,10 +771,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         # artifact for exactly the measurement's lifetime (both leaked
         # before: mkdtemp'd dirs nothing ever removed)
         with tempfile.TemporaryDirectory(prefix="stmgcn_serve_") as tmp:
+            def _phase(name):
+                # top-level bench phases bound the trace timeline, so the
+                # report's wall-coverage is honest even for legs whose
+                # inner spans live on worker/client threads; no-ops (and
+                # costs nothing) without --trace-out
+                from stmgcn_tpu.obs import trace as _tr
+
+                return _tr.span(name)
+
+            sp = _phase("bench.train_throwaway")
             fc, supports = train_throwaway(
                 rows=args.rows, slim=not args.full_model,
                 out_dir=os.path.join(tmp, "ckpt"),
             )
+            sp.end()
+            if args.trace_out:
+                # pin the train-loop recompile reading: every engine the
+                # legs below build compiles fresh programs (first-touch,
+                # not recompiles); the soak leg re-marks once its own
+                # warmup is done
+                jaxmon.freeze_recompiles()
+            sp = _phase("bench.serve")
             record = run_serve_bench(
                 fc, supports, batch=args.batch, buckets=buckets,
                 max_delay_ms=args.max_delay_ms, clients=args.clients,
@@ -745,23 +800,41 @@ def main(argv: Optional[List[str]] = None) -> int:
                 iters=args.iters,
                 artifact_path=os.path.join(tmp, "model.stmgx"),
             )
+            sp.end()
             if not args.no_fleet:
+                sp = _phase("bench.fleet")
                 record["fleet"] = run_fleet_serve_bench(
                     fc, supports, buckets=buckets,
                     max_delay_ms=args.max_delay_ms, clients=args.clients,
                     per_client=args.per_client, warmup=args.warmup,
                     iters=args.iters,
                 )
+                sp.end()
             if args.soak:
+                sp = _phase("bench.soak")
                 record["soak"] = run_soak_leg(
                     fc, supports, buckets=buckets,
                     max_delay_ms=args.max_delay_ms,
                     soak_seconds=args.soak_seconds,
                     overload=args.soak_overload,
                 )
+                sp.end()
         record["captured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )
+        if args.trace_out:
+            trc = obs_trace.active_tracer()
+            n_spans = trc.export_jsonl(args.trace_out) if trc else 0
+            record["obs"] = {
+                **jaxmon.snapshot(),
+                "trace_path": args.trace_out,
+                "trace_spans": n_spans,
+            }
+            print(
+                f"trace written to {args.trace_out} ({n_spans} spans) — "
+                f"inspect with `stmgcn obs {args.trace_out}`",
+                file=sys.stderr,
+            )
     finally:
         sys.stdout = record_stream
     print(json.dumps(record))

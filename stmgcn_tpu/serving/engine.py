@@ -50,6 +50,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from stmgcn_tpu.obs.registry import REGISTRY
 from stmgcn_tpu.serving.admission import (
     AdmissionController,
     BatcherWedged,
@@ -181,6 +182,7 @@ class CheckpointWatcher:
         )
         if got is None:
             self.rejected += 1
+            REGISTRY.counter("serving.ckpt_rejected").inc()
             return False
         path, _meta, params, _ = got
         try:
@@ -191,6 +193,7 @@ class CheckpointWatcher:
             # the newest file failed verification and the chain fell back
             # to something no newer than what is already serving
             self.rejected += 1
+            REGISTRY.counter("serving.ckpt_rejected").inc()
             return False
         eng.swap_params(params)
         self.swaps += 1
@@ -416,6 +419,8 @@ class ServingEngine:
         gen, cur_dev = self._current
         _check_swap_structure(cur_dev, new_dev)
         self._current = (gen + 1, new_dev)
+        REGISTRY.counter("serving.swaps").inc()
+        REGISTRY.gauge("serving.generation").set(gen + 1)
         return gen + 1
 
     def watch_checkpoints(self, out_dir: str, *, poll_s: Optional[float] = None,

@@ -44,6 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from stmgcn_tpu.obs.registry import REGISTRY
 from stmgcn_tpu.serving.admission import (
     AdmissionController,
     BatcherWedged,
@@ -268,6 +269,8 @@ class FleetServingEngine:
         gen, cur_dev = self._current
         _check_swap_structure(cur_dev, new_dev)
         self._current = (gen + 1, new_dev)
+        REGISTRY.counter("serving.swaps").inc()
+        REGISTRY.gauge("serving.generation").set(gen + 1)
         return gen + 1
 
     def watch_checkpoints(self, out_dir: str, *, poll_s: Optional[float] = None,

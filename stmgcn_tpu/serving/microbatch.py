@@ -55,6 +55,8 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from stmgcn_tpu.obs import jaxmon
+from stmgcn_tpu.obs import trace as obs_trace
 from stmgcn_tpu.serving.admission import (
     BatcherWedged,
     DeadlineExceeded,
@@ -158,6 +160,11 @@ class MicroBatcher:
                 raise self._wedged_error()
             if adm is not None:
                 adm.admit(req.n, self._pending_rows)  # raises the typed shed
+            trc = obs_trace.active_tracer()
+            if trc is not None:
+                # submit-entry -> admitted (lock wait + admission check)
+                trc.record_span("serve.admit", req.t_enqueue,
+                                time.perf_counter())
             self._pending.append(req)
             self._pending_rows += req.n
             # wake the worker only when it can act: the first arrival
@@ -267,6 +274,8 @@ class MicroBatcher:
         total = sum(req.n for req in batch)
         t0 = time.perf_counter()
         bucket = None
+        info = None
+        payload = None
         try:
             bucket = smallest_covering_bucket(total, self._buckets)
             if self._fault_plan is not None:
@@ -290,7 +299,6 @@ class MicroBatcher:
                     ofs += req.n
                 payload[total:] = 0.0
             out = self._dispatch(payload, bucket, tuple(segments))
-            info = None
             if isinstance(out, tuple):
                 out, info = out
             t1 = time.perf_counter()
@@ -334,3 +342,19 @@ class MicroBatcher:
         device_ms = (t1 - t0) * 1e3
         queue_ms = [(t0 - req.t_enqueue) * 1e3 for req in batch]
         self._stats.record_dispatch(bucket, total, queue_ms, device_ms)
+        if payload is not None and jaxmon.installed():
+            # the dispatch just moved the coalesced payload host->device
+            jaxmon.record_upload(payload.nbytes)
+        trc = obs_trace.active_tracer()
+        if trc is not None:
+            # retroactive per-dispatch spans (generation-stamped): the
+            # device window is honest — the dispatch materializes host
+            # numpy (np.array readback) before t1 — and each coalesced
+            # request contributes its own queue-wait span
+            t_end = time.perf_counter()
+            attrs = {"bucket": bucket, "rows": total,
+                     "requests": len(batch), "gen": info}
+            for req in batch:
+                trc.record_span("serve.queue", req.t_enqueue, t0)
+            trc.record_span("serve.device", t0, t1, attrs)
+            trc.record_span("serve.scatter", t1, t_end, attrs)

@@ -66,6 +66,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from stmgcn_tpu.data.pipeline import DemandDataset
+from stmgcn_tpu.obs import jaxmon
+from stmgcn_tpu.obs import trace as obs_trace
+from stmgcn_tpu.obs.registry import REGISTRY
 from stmgcn_tpu.resilience.faults import FaultPlan, Preempted
 from stmgcn_tpu.resilience.guard import DivergenceGuard
 from stmgcn_tpu.train.checkpoint import (
@@ -75,6 +78,7 @@ from stmgcn_tpu.train.checkpoint import (
     write_checkpoint_bytes,
 )
 from stmgcn_tpu.train.metrics import regression_report
+from stmgcn_tpu.utils.profiling import fence
 from stmgcn_tpu.train.step import (
     StepFns,
     gather_window_batch,
@@ -616,13 +620,15 @@ class Trainer:
                 )
             else:
                 self.fallback_reason = "superstep prerequisites not met"
-        if self.fallback_reason is not None and self.is_lead:
-            print(
+        if self.fallback_reason is not None:
+            self._event(
+                "slow_path",
                 f"[slow-path] {self.fallback_reason} "
                 f"(steps_per_superstep={steps_per_superstep}, "
                 f"train_path={self.train_path})",
-                file=sys.stderr,
-                flush=True,
+                stream=sys.stderr,
+                reason=self.fallback_reason,
+                train_path=self.train_path,
             )
 
     # -- paths ----------------------------------------------------------
@@ -643,6 +649,23 @@ class Trainer:
         if self.verbose and self.is_lead:
             print(msg, flush=True)
 
+    def _event(self, name: str, text: str, *, stream=None, **attrs) -> None:
+        """Structured phase event: counted in the shared registry, stamped
+        into the active trace (zero-duration span), and rendered as the
+        SAME human-readable text the loop always printed — through
+        :meth:`_log` by default, or lead-only to ``stream`` (the
+        slow-path warning keeps its stderr contract)."""
+        REGISTRY.counter("train.events", {"event": name}).inc()
+        trc = obs_trace.active_tracer()
+        if trc is not None:
+            t = time.perf_counter()
+            trc.record_span(f"event.{name}", t, t, attrs or None)
+        if stream is not None:
+            if self.is_lead:
+                print(text, file=stream, flush=True)
+        else:
+            self._log(text)
+
     def _record(self, record: dict) -> None:
         if not self.is_lead:
             return
@@ -654,6 +677,8 @@ class Trainer:
         (lead process only) so equal-content snapshots reuse them."""
         if not self.is_lead:
             return None
+        trc = obs_trace.active_tracer()
+        t0 = time.perf_counter() if trc is not None else 0.0
         data = serialize_checkpoint(self.params, self.opt_state, self._meta())
         if path == self.latest_path:
             # rotate before overwriting: if this write lands corrupt (disk
@@ -661,6 +686,13 @@ class Trainer:
             # state and load_latest_verified falls back to it
             self._rotate(path, self.latest_prev_path)
         self._write(path, data)
+        REGISTRY.counter("train.checkpoint_writes").inc()
+        if trc is not None:
+            # serialize + enqueue/write; the async worker's IO is off-thread
+            t1 = time.perf_counter()
+            trc.record_span("train.checkpoint", t0, t1,
+                            {"path": os.path.basename(path),
+                             "bytes": len(data)})
         return data
 
     def _rotate(self, src: str, dst: str) -> None:
@@ -1384,12 +1416,32 @@ class Trainer:
                 "batches — checkpoint from a different data configuration?"
             )
         pending = batches[skip:]
-        blocks, remainder = self._pack_blocks(pending, mode)
+        trc = obs_trace.active_tracer()
+        if trc is None:
+            blocks, remainder = self._pack_blocks(pending, mode)
+        else:
+            t_p0 = time.perf_counter()
+            blocks, remainder = self._pack_blocks(pending, mode)
+            t_p1 = time.perf_counter()
+            trc.record_span("train.host_pack", t_p0, t_p1,
+                            {"blocks": len(blocks)})
         plan, guard = self.fault_plan, self._guard
 
         def place(block):
             idx_np, mask_np, n_reals = block
             return jnp.asarray(idx_np), jnp.asarray(mask_np), n_reals
+
+        if trc is None:
+            placer = place  # the hot loop binds the raw fn: zero obs cost
+        else:
+            def placer(block):
+                t0 = time.perf_counter()
+                out = place(block)
+                t1 = time.perf_counter()
+                nbytes = block[0].nbytes + block[1].nbytes
+                jaxmon.record_upload(nbytes)
+                trc.record_span("train.upload", t0, t1, {"bytes": nbytes})
+                return out
 
         def per_step_block(i):
             for batch in pending[i * S:(i + 1) * S]:
@@ -1397,14 +1449,14 @@ class Trainer:
                 self._train_one(batch, x, y, mask)
                 self._after_train_batch()
 
-        placed = place(blocks[0]) if blocks else None
+        placed = placer(blocks[0]) if blocks else None
         for i in range(len(blocks)):
             start = self._batch_in_epoch
             plan.before_step(self.epoch, start, start + S)
             if plan.active and plan.any_drop(self.epoch, start, start + S):
                 # a dropped microbatch breaks the fused block's uniform
                 # shape — run these S batches per-step instead
-                placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+                placed = placer(blocks[i + 1]) if i + 1 < len(blocks) else None
                 per_step_block(i)
                 continue
             idx_d, mask_d, n_reals = placed
@@ -1420,9 +1472,19 @@ class Trainer:
                     jax.tree.map(jnp.copy, self.params),
                     jax.tree.map(jnp.copy, self.opt_state),
                 )
+            t_d0 = 0.0 if trc is None else time.perf_counter()
             self.params, self.opt_state, loss_vec = dispatch(idx_d, mask_d)
             # superstep i is dispatched; upload block i+1 under its compute
-            placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+            placed = placer(blocks[i + 1]) if i + 1 < len(blocks) else None
+            if trc is not None:
+                # close the span on the readback fence so it covers device
+                # compute, not just dispatch enqueue; fencing AFTER the
+                # next block's placement keeps the double buffer's
+                # upload/compute overlap intact
+                fence(loss_vec)
+                t_d1 = time.perf_counter()
+                trc.record_span("train.superstep", t_d0, t_d1,
+                                {"step": start, "s": S})
             if guard is not None and not np.isfinite(np.asarray(loss_vec)).all():
                 # a scanned step fed NaN forward into every later step of
                 # the block: roll the whole block back and replay it
@@ -1517,6 +1579,19 @@ class Trainer:
             idx_np, mask_np, n_reals = block
             return jnp.asarray(idx_np), jnp.asarray(mask_np), n_reals
 
+        trc = obs_trace.active_tracer()
+        if trc is None:
+            placer = place  # the hot loop binds the raw fn: zero obs cost
+        else:
+            def placer(block):
+                t0 = time.perf_counter()
+                out = place(block)
+                t1 = time.perf_counter()
+                nbytes = block[0].nbytes + block[1].nbytes
+                jaxmon.record_upload(nbytes)
+                trc.record_span("train.upload", t0, t1, {"bytes": nbytes})
+                return out
+
         for city, run in runs:
             info = self._fleet_cities.get(city)
             if info is None:  # no shape class fits: the per-step loop
@@ -1537,12 +1612,12 @@ class Trainer:
                 for batch in run[i * S:(i + 1) * S]:
                     per_step(batch)
 
-            placed = place(blocks[0]) if blocks else None
+            placed = placer(blocks[0]) if blocks else None
             for i in range(len(blocks)):
                 start = self._batch_in_epoch
                 plan.before_step(self.epoch, start, start + S)
                 if plan.active and plan.any_drop(self.epoch, start, start + S):
-                    placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+                    placed = placer(blocks[i + 1]) if i + 1 < len(blocks) else None
                     per_step_block(i)
                     continue
                 idx_d, mask_d, n_reals = placed
@@ -1558,6 +1633,7 @@ class Trainer:
                         jax.tree.map(jnp.copy, self.params),
                         jax.tree.map(jnp.copy, self.opt_state),
                     )
+                t_d0 = 0.0 if trc is None else time.perf_counter()
                 self.params, self.opt_state, loss_vec = (
                     self._fleet_fns.train_superstep(
                         self.params, self.opt_state, sup_stack, series,
@@ -1565,7 +1641,13 @@ class Trainer:
                     )
                 )
                 # block i is dispatched; upload i+1 under its compute
-                placed = place(blocks[i + 1]) if i + 1 < len(blocks) else None
+                placed = placer(blocks[i + 1]) if i + 1 < len(blocks) else None
+                if trc is not None:
+                    # fence AFTER the next placement: overlap preserved
+                    fence(loss_vec)
+                    t_d1 = time.perf_counter()
+                    trc.record_span("train.superstep", t_d0, t_d1,
+                                    {"step": start, "s": S, "city": city})
                 if guard is not None and not np.isfinite(
                     np.asarray(loss_vec)
                 ).all():
@@ -1599,7 +1681,7 @@ class Trainer:
         handler is restored on the way out.
         """
         history = {"train": [], "validate": []}
-        self._log(f"Training starts at: {time.ctime()}")
+        self._event("train_start", f"Training starts at: {time.ctime()}")
         in_main = threading.current_thread() is threading.main_thread()
         prev_handler = None
         if in_main:
@@ -1629,17 +1711,30 @@ class Trainer:
             if in_main:
                 signal.signal(signal.SIGTERM, prev_handler)
         self.flush_checkpoints()
-        self._log(f"Training ends at: {time.ctime()}")
+        self._event("train_end", f"Training ends at: {time.ctime()}")
         return history
 
     def _epoch_loop(self, history: dict, start_epoch: int) -> None:
         for epoch in range(start_epoch, self.n_epochs + 1):
             self.epoch = epoch
             t0 = time.time()
+            trc = obs_trace.active_tracer()
+            sp_epoch = None if trc is None else trc.span("train.epoch", epoch=epoch)
+            sp = None if trc is None else trc.span("train.train_epoch")
             train_loss = self._run_epoch("train", train=True)
+            if sp is not None:
+                sp.end()
             self._check_preempt()
+            sp = None if trc is None else trc.span("train.eval_epoch")
             val_loss = self._run_epoch("validate", train=False)
+            if sp is not None:
+                sp.end()
             self._check_preempt()
+            if epoch == start_epoch and jaxmon.installed():
+                # every train/eval program has traced once (pad_last keeps
+                # batch shapes constant) — any later compile is a runtime
+                # recompile, surfaced by the recompiles_after_warmup gauge
+                jaxmon.mark_warmup_complete()
             # the epoch's batches are all consumed: zero the resume cursor
             # *before* the bookkeeping saves below, so their meta points a
             # resume at epoch+1. A preemption before this line instead
@@ -1692,6 +1787,8 @@ class Trainer:
                     "seconds": round(time.time() - t0, 3),
                 }
             )
+            if sp_epoch is not None:
+                sp_epoch.end()
             if self.patience_left == 0:
                 self._log(f"Early stopping at epoch {epoch}..")
                 break
@@ -1811,6 +1908,7 @@ class Trainer:
         self.params = self.placement.put(params, "state")
         self.opt_state = self.placement.put(opt_state, "state")
         self._apply_meta(meta)
+        REGISTRY.counter("train.checkpoint_recoveries").inc()
         return meta
 
     def restore_auto(self) -> Optional[dict]:
@@ -1834,6 +1932,7 @@ class Trainer:
             self.params = self.placement.put(params, "state")
             self.opt_state = self.placement.put(opt_state, "state")
             self._apply_meta(meta)
+            REGISTRY.counter("train.checkpoint_recoveries").inc()
             self._log(
                 f"resumed from {path} (epoch {self.epoch}, "
                 f"step {self.global_step})"
@@ -1894,7 +1993,13 @@ class Trainer:
             path = self.best_path if checkpoint == "best" else checkpoint
             _, params, _ = self._load_state(path)
             params = self.placement.put(params, "state")
-        self._log(f"Testing starts at: {time.ctime()}")
+        self._event("test_start", f"Testing starts at: {time.ctime()}")
+        if jaxmon.installed():
+            # the warmed training loop is over: pin recompiles_after_warmup
+            # so evaluation's first-touch programs (test-split gathers were
+            # never traced during training) don't read as loop recompiles
+            jaxmon.freeze_recompiles()
+        sp_test = obs_trace.span("train.test")  # no-op when tracing is off
         hetero = getattr(self.dataset, "heterogeneous", False)
         results = {}
         for mode in modes:
@@ -1953,5 +2058,6 @@ class Trainer:
                         f"  {mode}/{name} RMSE: {rep['rmse']:.6g}  "
                         f"MAE: {rep['mae']:.6g}  PCC: {rep['pcc']:.4g}"
                     )
-        self._log(f"Testing ends at: {time.ctime()}")
+        sp_test.end()
+        self._event("test_end", f"Testing ends at: {time.ctime()}")
         return results

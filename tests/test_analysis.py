@@ -817,6 +817,79 @@ class TestServingSLORule:
         assert check_serving_slo([("none", object())]) == []
 
 
+class TestObsOverheadRule:
+    """Pass 2h: the obs-overhead budget contract — observability knobs
+    that would make the measurement layer a memory regression of its
+    own. Boundaries pinned exactly: the documented budget itself is
+    clean, one past it is flagged; ring bounds apply only once tracing
+    actually allocates a ring."""
+
+    @staticmethod
+    def _cfg(**kw):
+        from stmgcn_tpu.config import ObsConfig, preset
+
+        cfg = preset("smoke")
+        cfg.obs = ObsConfig(**kw)
+        return cfg
+
+    def test_rule_registered_as_error(self):
+        assert RULES["obs-overhead"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_obs_overhead
+
+        assert check_obs_overhead() == []
+
+    def test_reservoir_budget_boundary(self):
+        from stmgcn_tpu.analysis import check_obs_overhead
+        from stmgcn_tpu.config import OBS_RESERVOIR_BUDGET
+
+        # reservoir bounds apply even with tracing OFF — EngineStats
+        # histograms exist in every serving process
+        f = check_obs_overhead(
+            [("bad", self._cfg(reservoir=OBS_RESERVOIR_BUDGET + 1))]
+        )
+        assert f and all(x.rule == "obs-overhead" for x in f)
+        assert all(x.severity == "error" for x in f)
+        assert any("budget" in x.message for x in f)
+        assert f[0].path == "<contract:obs:bad>"
+        assert check_obs_overhead(
+            [("ok", self._cfg(reservoir=OBS_RESERVOIR_BUDGET))]
+        ) == []
+
+    def test_reservoir_must_be_positive(self):
+        from stmgcn_tpu.analysis import check_obs_overhead
+
+        f = check_obs_overhead([("bad", self._cfg(reservoir=0))])
+        assert any("positive sample bound" in x.message for x in f)
+        assert check_obs_overhead([("ok", self._cfg(reservoir=1))]) == []
+
+    def test_ring_bounds_only_checked_when_tracing(self):
+        from stmgcn_tpu.analysis import check_obs_overhead
+        from stmgcn_tpu.config import OBS_RING_BUDGET
+
+        # tracing off: an absurd ring is dormant config, not a finding
+        assert check_obs_overhead(
+            [("off", self._cfg(trace=False, ring_capacity=0))]
+        ) == []
+        f = check_obs_overhead(
+            [("on", self._cfg(trace=True, ring_capacity=0))]
+        )
+        assert any("unbounded span buffer" in x.message for x in f)
+        f = check_obs_overhead(
+            [("on", self._cfg(trace=True, ring_capacity=OBS_RING_BUDGET + 1))]
+        )
+        assert any("rotate" in x.message for x in f)
+        assert check_obs_overhead(
+            [("on", self._cfg(trace=True, ring_capacity=OBS_RING_BUDGET))]
+        ) == []
+
+    def test_configs_without_obs_section_skipped(self):
+        from stmgcn_tpu.analysis import check_obs_overhead
+
+        assert check_obs_overhead([("none", object())]) == []
+
+
 class TestResidentMemoryRule:
     """Pass 2f: the resident-memory footprint contract (pure config math
     — the same arithmetic as DemandDataset.resident_nbytes/nbytes,
@@ -1445,3 +1518,8 @@ class TestLintGateScript:
             "exit": 0, "errors": 0, "warnings": 0, "version": 2,
         }
         assert set(payload["ruff"]) == {"available", "exit"}
+        # the traced smoke run: compiled fine, traced spans, and — the
+        # dynamic recompile gate — NOTHING compiled after warmup
+        assert payload["obs"]["exit"] == 0
+        assert payload["obs"]["recompiles_after_warmup"] == 0
+        assert payload["obs"]["trace_spans"] > 0

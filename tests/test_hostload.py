@@ -15,6 +15,7 @@ from stmgcn_tpu.utils.hostload import (
     PROBE_SRC,
     BenchLock,
     host_load_snapshot,
+    is_contended,
     wait_for_probe_children,
 )
 
@@ -32,6 +33,23 @@ def test_snapshot_excludes_self_and_ancestors():
     pids = {p["pid"] for p in host_load_snapshot()["competing_python"]}
     assert os.getpid() not in pids
     assert os.getppid() not in pids
+
+
+def test_is_contended_detects_either_side():
+    quiet = {"competing_python": []}
+    busy = {"competing_python": [{"pid": 1, "cmd": "python x.py"}]}
+    assert is_contended({"before": quiet, "after": quiet}) is False
+    assert is_contended({"before": busy, "after": quiet}) is True
+    assert is_contended({"before": quiet, "after": busy}) is True
+    assert is_contended({"before": busy, "after": busy}) is True
+
+
+def test_is_contended_tolerates_missing_fields():
+    # records from older schema versions / partial probes must not crash
+    assert is_contended({}) is False
+    assert is_contended({"before": None, "after": None}) is False
+    assert is_contended({"before": {}, "after": {}}) is False
+    assert is_contended({"after": {"competing_python": [{"pid": 2}]}}) is True
 
 
 def test_lock_excludes_second_holder(tmp_path):

@@ -1,0 +1,540 @@
+"""Unified runtime observability (stmgcn_tpu/obs/).
+
+Pins the PR's contracts: the span tracer's bounded ring + nesting +
+JSONL schema, the process-wide metrics registry and its two exporters,
+the ``jax.monitoring`` compile telemetry (warmup mark / freeze), the
+``stmgcn obs`` CLI's one-JSON-line stdout contract, the bounded
+reservoirs that replaced ``serving/metrics.py``'s unbounded lists, the
+``EngineStats.device_ms_estimate`` cold-start fallback chain, and —
+the expensive claim — bit-identical training results with tracing on.
+
+The module-global tracer is process state: every test that calls
+``obs_trace.configure`` must disable it again (the autouse fixture
+below enforces this), or later tests in the same process would run
+instrumented.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.obs import jaxmon
+from stmgcn_tpu.obs import trace as obs_trace
+from stmgcn_tpu.obs.cli import main as obs_main
+from stmgcn_tpu.obs.registry import (
+    REGISTRY,
+    MetricsRegistry,
+    Reservoir,
+)
+from stmgcn_tpu.obs.report import load_trace, render_table, summarize
+from stmgcn_tpu.obs.trace import SCHEMA_VERSION, Tracer
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    yield
+    obs_trace.configure(enable=False)
+
+
+# -- tracer ------------------------------------------------------------
+
+
+class TestTracer:
+    def test_nesting_parent_depth(self):
+        trc = Tracer()
+        with trc.span("outer"):
+            with trc.span("inner", step=3):
+                pass
+        outer = next(s for s in trc.spans() if s["name"] == "outer")
+        inner = next(s for s in trc.spans() if s["name"] == "inner")
+        assert inner["parent"] == outer["id"] and inner["depth"] == 1
+        assert outer["parent"] == 0 and outer["depth"] == 0
+        assert inner["attrs"] == {"step": 3}
+
+    def test_record_span_inherits_open_nesting(self):
+        import time
+
+        trc = Tracer()
+        with trc.span("outer") as sp:
+            t0 = time.perf_counter()
+            trc.record_span("retro", t0, t0 + 0.001)
+            sp.end()
+        retro = next(s for s in trc.spans() if s["name"] == "retro")
+        outer = next(s for s in trc.spans() if s["name"] == "outer")
+        assert retro["parent"] == outer["id"]
+
+    def test_ring_is_bounded_and_counts_drops(self):
+        trc = Tracer(capacity=8)
+        for i in range(20):
+            trc.record_span(f"s{i}", 0.0, 0.001)
+        assert len(trc.spans()) == 8
+        assert trc.dropped == 12
+        # the ring keeps the most RECENT window
+        assert trc.spans()[-1]["name"] == "s19"
+
+    def test_end_is_idempotent(self):
+        trc = Tracer()
+        sp = trc.span("once")
+        sp.end()
+        sp.end()
+        assert len(trc.spans()) == 1
+
+    def test_unbalanced_close_unwinds_stack(self):
+        trc = Tracer()
+        outer = trc.span("outer")
+        trc.span("abandoned")  # never closed (exception path analogue)
+        outer.end()
+        nxt = trc.span("after")
+        assert nxt.parent == 0 and nxt.depth == 0
+        nxt.end()
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            Tracer(capacity=0)
+
+    def test_export_jsonl_schema(self, tmp_path):
+        trc = Tracer(capacity=16)
+        with trc.span("a"):
+            with trc.span("b"):
+                pass
+        path = str(tmp_path / "t.jsonl")
+        n = trc.export_jsonl(path)
+        assert n == 2
+        lines = open(path).read().splitlines()
+        assert len(lines) == 3  # meta header + one object per span
+        objs = [json.loads(line) for line in lines]  # every line is JSON
+        meta, spans = objs[0], objs[1:]
+        assert meta["kind"] == "meta"
+        assert meta["schema_version"] == SCHEMA_VERSION
+        assert meta["capacity"] == 16 and meta["spans"] == 2
+        for s in spans:
+            assert s["schema_version"] == SCHEMA_VERSION
+            for key in ("id", "parent", "depth", "name", "ts", "dur_ms"):
+                assert key in s
+
+    def test_disabled_path_allocates_nothing(self):
+        obs_trace.configure(enable=False)
+        assert obs_trace.active_tracer() is None
+        assert obs_trace.enabled() is False
+        # the casual-path span() hands back ONE shared no-op object — the
+        # zero-allocation contract the superstep hot loop relies on
+        assert obs_trace.span("x") is obs_trace.span("y")
+        with obs_trace.span("z") as sp:
+            sp.fence(None)  # no-ops, never imports jax
+
+    def test_module_switch_roundtrip(self):
+        trc = obs_trace.configure(capacity=32)
+        assert obs_trace.active_tracer() is trc and obs_trace.enabled()
+        with obs_trace.span("on"):
+            pass
+        assert trc.spans()[0]["name"] == "on"
+        obs_trace.configure(enable=False)
+        assert obs_trace.active_tracer() is None
+
+
+# -- report / summarize ------------------------------------------------
+
+
+class TestReport:
+    def _trace(self, tmp_path):
+        import time
+
+        trc = Tracer()
+        with trc.span("epoch") as sp:
+            t0 = time.perf_counter()
+            time.sleep(0.02)
+            trc.record_span("step", t0, time.perf_counter())
+            sp.end()
+        path = str(tmp_path / "t.jsonl")
+        trc.export_jsonl(path)
+        return path
+
+    def test_summarize_self_time_subtracts_children(self, tmp_path):
+        meta, spans = load_trace(self._trace(tmp_path))
+        assert meta["kind"] == "meta"
+        summary = summarize(spans)
+        phases = {p["name"]: p for p in summary["phases"]}
+        # a leaf keeps its full duration as self time ...
+        assert phases["step"]["self_ms"] == phases["step"]["total_ms"]
+        # ... and the child's duration comes out of the parent's
+        assert phases["epoch"]["self_ms"] == pytest.approx(
+            phases["epoch"]["total_ms"] - phases["step"]["total_ms"],
+            abs=0.005,
+        )
+        assert 0.0 < summary["coverage"] <= 1.01
+        assert "wall_ms" in summary
+
+    def test_render_table_mentions_every_phase(self, tmp_path):
+        meta, spans = load_trace(self._trace(tmp_path))
+        table = render_table(summarize(spans), meta)
+        assert "epoch" in table and "step" in table
+        assert "coverage" in table
+
+    def test_load_trace_rejects_garbage(self, tmp_path):
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("not json\n")
+        with pytest.raises((ValueError, json.JSONDecodeError)):
+            load_trace(str(bad))
+
+
+# -- metrics registry --------------------------------------------------
+
+
+class TestRegistry:
+    def test_get_or_create_identity(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("x", {"a": "1"})
+        c2 = reg.counter("x", {"a": "1"})
+        assert c1 is c2
+        assert reg.counter("x", {"a": "2"}) is not c1
+        c1.inc()
+        c1.inc(2.5)
+        assert c1.value == 3.5
+
+    def test_kind_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_to_json_shapes(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(3)
+        reg.gauge("g").set(1.5)
+        reg.histogram("h").extend([1.0, 2.0, 3.0])
+        snap = reg.to_json()
+        assert snap["c"] == 3  # whole floats render as ints
+        assert snap["g"] == 1.5
+        assert snap["h"]["count"] == 3 and snap["h"]["p50"] == 2.0
+        # labeled metrics render name{k=v}
+        reg.counter("c", {"engine": "0"}).inc()
+        assert reg.to_json()["c{engine=0}"] == 1
+
+    def test_to_prometheus_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("serving.shed", {"reason": "overloaded"}).inc(4)
+        reg.histogram("latency-ms").add(7.0)
+        text = reg.to_prometheus()
+        assert "# TYPE serving_shed counter" in text
+        assert 'serving_shed{reason="overloaded"} 4.0' in text
+        assert "# TYPE latency_ms summary" in text
+        assert 'latency_ms{quantile="0.5"} 7.0' in text
+        assert "latency_ms_count 1" in text
+        assert text.endswith("\n")
+
+    def test_reset_keeps_registrations_alive(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x")
+        c.inc(5)
+        reg.reset()
+        assert c.value == 0.0
+        assert reg.counter("x") is c  # held references stay live
+
+    def test_dumps_is_one_json_doc(self):
+        reg = MetricsRegistry()
+        reg.counter("a").inc()
+        assert json.loads(reg.dumps()) == {"a": 1}
+
+
+class TestReservoir:
+    def test_bounded_retention_keeps_recent(self):
+        r = Reservoir(capacity=4)
+        r.extend(range(10))
+        assert r.samples() == [6, 7, 8, 9]
+        assert r.count == 10  # all-time count survives eviction
+        assert r.total == sum(range(10))
+
+    def test_percentile_shape_matches_serving_metrics(self):
+        r = Reservoir(capacity=16)
+        assert r.percentiles() == {
+            "p50": None, "p95": None, "p99": None, "mean": None,
+        }
+        r.extend([1.0, 2.0, 3.0, 4.0])
+        from stmgcn_tpu.serving.metrics import percentiles
+
+        assert r.percentiles() == percentiles([1.0, 2.0, 3.0, 4.0])
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            Reservoir(capacity=0)
+
+    def test_mean_default_when_empty(self):
+        assert Reservoir(capacity=2).mean(default=9.5) == 9.5
+
+
+# -- jax monitoring ----------------------------------------------------
+
+
+class TestJaxMonitoring:
+    def test_install_idempotent_and_counts_compiles(self):
+        import jax
+        import jax.numpy as jnp
+
+        assert jaxmon.install() is True
+        assert jaxmon.install() is True  # second call must not re-register
+        assert jaxmon.installed()
+        before = REGISTRY.counter("jax.compilations").value
+
+        @jax.jit
+        def f(x):
+            return x * 2 + 1
+
+        f(jnp.arange(7)).block_until_ready()
+        assert REGISTRY.counter("jax.compilations").value > before
+
+    def test_warmup_mark_and_recompile_gauge(self):
+        import jax
+        import jax.numpy as jnp
+
+        assert jaxmon.install() is True
+        jaxmon.mark_warmup_complete()
+        assert jaxmon.snapshot()["recompiles_after_warmup"] == 0
+
+        @jax.jit
+        def g(x):
+            return x - 3
+
+        g(jnp.arange(11)).block_until_ready()  # a compile after the mark
+        snap = jaxmon.snapshot()
+        assert snap["recompiles_after_warmup"] >= 1
+
+        # freeze pins the reading; later compiles stay invisible
+        frozen = jaxmon.freeze_recompiles()
+        g(jnp.arange(13).astype(jnp.float32)).block_until_ready()
+        assert jaxmon.snapshot()["recompiles_after_warmup"] == int(frozen)
+        # re-marking unfreezes and re-baselines
+        jaxmon.mark_warmup_complete()
+        assert jaxmon.snapshot()["recompiles_after_warmup"] == 0
+
+    def test_record_upload_and_per_step_rate(self):
+        before = REGISTRY.counter("jax.upload_bytes").value
+        jaxmon.record_upload(1000)
+        jaxmon.record_upload(1000)
+        snap = jaxmon.snapshot(steps=2)
+        assert snap["upload_bytes"] - int(before) == 2000
+        assert "upload_bytes_per_step" in snap
+
+
+# -- stmgcn obs CLI ----------------------------------------------------
+
+
+class TestObsCli:
+    def _trace(self, tmp_path):
+        trc = Tracer()
+        with trc.span("phase"):
+            trc.record_span("work", 0.0, 1.0)
+        path = str(tmp_path / "t.jsonl")
+        trc.export_jsonl(path)
+        return path
+
+    def test_json_format_is_one_line(self, tmp_path, capsys):
+        rc = obs_main([self._trace(tmp_path), "--format", "json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.count("\n") == 1 and out.endswith("\n")
+        doc = json.loads(out)
+        assert doc["meta"]["kind"] == "meta"
+        assert {"wall_ms", "coverage", "phases"} <= set(doc["summary"])
+        assert "spans" not in doc  # only with --dump
+
+    def test_json_dump_includes_spans(self, tmp_path, capsys):
+        rc = obs_main([self._trace(tmp_path), "--format", "json", "--dump"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and len(doc["spans"]) == 2
+
+    def test_text_renders_table(self, tmp_path, capsys):
+        rc = obs_main([self._trace(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0 and "phase" in out and "coverage" in out
+
+    def test_missing_file_exits_2(self, tmp_path, capsys):
+        rc = obs_main([str(tmp_path / "nope.jsonl")])
+        err = capsys.readouterr().err
+        assert rc == 2 and "cannot read" in err
+
+    def test_obs_package_is_lean(self):
+        """Importing stmgcn_tpu.obs must not pull jax (serving/export
+        import it at module scope; their leanness contracts inherit)."""
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import sys; import stmgcn_tpu.obs; "
+                "print('JAX' if any(m == 'jax' or m.startswith('jax.') "
+                "for m in sys.modules) else 'LEAN')",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.stdout.strip() == "LEAN", out.stderr
+
+
+# -- EngineStats: bounded reservoirs + cold-start fallback --------------
+
+
+class TestEngineStats:
+    def test_reservoir_bounds_memory(self):
+        from stmgcn_tpu.serving.metrics import EngineStats
+
+        stats = EngineStats(reservoir=8)
+        for i in range(100):
+            stats.record_dispatch(4, 4, [float(i)], float(i))
+        snap = stats.snapshot()
+        bucket = snap["buckets"]["4"]
+        assert bucket["dispatches"] == 100  # all-time totals survive
+        # but the retained window is the last 8 samples: p50 of 92..99
+        assert bucket["device_ms"]["p50"] == 95.5
+        assert snap["totals"]["dispatches"] == 100
+
+    def test_device_ms_estimate_fallback_chain(self):
+        from stmgcn_tpu.serving.metrics import EngineStats
+
+        stats = EngineStats()
+        # 1. stone cold: no rung has samples -> the caller's default
+        assert stats.device_ms_estimate(4, default=7.5) == 7.5
+        # 2. rung miss, other rungs warm -> global mean
+        stats.record_dispatch(16, 16, [1.0], 10.0)
+        stats.record_dispatch(16, 16, [1.0], 20.0)
+        assert stats.device_ms_estimate(4, default=7.5) == 15.0
+        # 3. rung warm -> that rung's own mean wins
+        stats.record_dispatch(4, 4, [1.0], 2.0)
+        assert stats.device_ms_estimate(4, default=7.5) == 2.0
+
+    def test_snapshot_totals_come_from_registry(self):
+        from stmgcn_tpu.serving.metrics import EngineStats
+
+        stats = EngineStats()
+        stats.record_dispatch(4, 3, [1.0, 1.0, 1.0], 5.0)
+        engine_label = stats._labels["engine"]
+        assert (
+            REGISTRY.counter("serving.rows", {"engine": engine_label}).value
+            == 3.0
+        )
+        assert stats.snapshot()["totals"]["rows"] == 3
+
+    def test_shed_counts_registry_backed(self):
+        from stmgcn_tpu.serving.metrics import EngineStats
+
+        stats = EngineStats()
+        stats.record_shed("overloaded")
+        stats.record_shed("overloaded")
+        stats.record_shed("degraded")
+        assert stats.shed_counts() == {"overloaded": 2, "degraded": 1}
+        assert stats.snapshot()["totals"]["shed"] == {
+            "overloaded": 2, "degraded": 1,
+        }
+
+
+# -- tracing-on bit parity ---------------------------------------------
+
+
+def _train_tiny(trace: bool, tmp_path, steps_per_superstep=2):
+    from stmgcn_tpu.config import preset
+    from stmgcn_tpu.experiment import build_trainer
+
+    trc = obs_trace.configure(enable=trace)
+    try:
+        cfg = preset("smoke")
+        cfg.data.rows = 5
+        cfg.data.n_timesteps = 24 * 7 * 2 + 60
+        cfg.train.epochs = 2
+        cfg.train.batch_size = 8
+        cfg.train.data_placement = "resident"
+        cfg.train.steps_per_superstep = steps_per_superstep
+        cfg.train.out_dir = str(tmp_path / ("traced" if trace else "plain"))
+        trainer = build_trainer(cfg, verbose=False)
+        history = trainer.train()
+        return trainer.params, history, trc
+    finally:
+        obs_trace.configure(enable=False)
+
+
+class TestTracedParity:
+    def test_tracing_is_bit_invisible_to_training(self, tmp_path):
+        """The PR's core safety claim: spans + fences change WHEN the
+        host observes device results, never the results themselves —
+        and the traced superstep run emits every expected phase."""
+        import jax
+
+        params_plain, hist_plain, _ = _train_tiny(False, tmp_path)
+        params_traced, hist_traced, trc = _train_tiny(True, tmp_path)
+        jax.tree.map(
+            np.testing.assert_array_equal, params_plain, params_traced
+        )
+        assert hist_plain == hist_traced
+
+        names = {s["name"] for s in trc.spans()}
+        assert {
+            "train.host_pack", "train.upload", "train.superstep",
+            "train.epoch", "train.train_epoch", "train.eval_epoch",
+            "train.checkpoint", "event.train_start", "event.train_end",
+        } <= names
+
+
+# -- slow tier: end-to-end CLI trace contracts --------------------------
+
+
+@pytest.mark.slow
+class TestTraceCliContract:
+    def test_traced_run_schema_and_obs_cli_stdout(self, tmp_path):
+        """The JSONL schema contract on a REAL `--trace-out` training run
+        (one JSON object per line, schema_version everywhere, spans nest)
+        plus the one-JSON-line stdout contract of `stmgcn obs --format
+        json` over that trace."""
+        trace_path = str(tmp_path / "trace.jsonl")
+        run = subprocess.run(
+            [
+                sys.executable, "-m", "stmgcn_tpu.cli",
+                "--preset", "smoke",
+                "--rows", "5", "--timesteps", str(24 * 7 * 2 + 60),
+                "--epochs", "2", "--batch-size", "8",
+                "--data-placement", "resident",
+                "--steps-per-superstep", "2",
+                "--out-dir", str(tmp_path / "out"),
+                "--trace-out", trace_path,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=560,
+        )
+        assert run.returncode == 0, run.stderr[-2000:]
+
+        lines = open(trace_path).read().splitlines()
+        assert len(lines) >= 2
+        objs = [json.loads(line) for line in lines]  # one object per line
+        meta, spans = objs[0], objs[1:]
+        assert meta["kind"] == "meta"
+        assert meta["schema_version"] == SCHEMA_VERSION
+        assert meta["spans"] == len(spans)
+        ids = set()
+        for s in spans:
+            assert s["schema_version"] == SCHEMA_VERSION
+            assert s["dur_ms"] >= 0.0
+            ids.add(s["id"])
+        for s in spans:  # nesting: every parent is a recorded span (or root)
+            assert s["parent"] == 0 or s["parent"] in ids
+            if s["parent"] in ids:
+                assert s["depth"] >= 1
+
+        # span durations must account for >= 90% of the wall window
+        summary = summarize(spans)
+        assert summary["coverage"] >= 0.90, summary
+
+        obs = subprocess.run(
+            [
+                sys.executable, "-m", "stmgcn_tpu.cli",
+                "obs", trace_path, "--format", "json",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert obs.returncode == 0, obs.stderr
+        assert obs.stdout.count("\n") == 1  # EXACTLY one JSON line
+        doc = json.loads(obs.stdout)
+        assert doc["meta"]["spans"] == len(spans)
+        assert doc["summary"]["coverage"] >= 0.90

@@ -6,9 +6,17 @@
 # JSON line:
 #
 #   {"gate": "PASS"|"FAIL", "lint": {"exit": N, "errors": N,
-#    "warnings": N, "version": N}, "ruff": {"available": true|false,
-#    "exit": N|null}, "obs": {"exit": N, "recompiles_after_warmup":
-#    N|null, "trace_spans": N|null}}
+#    "warnings": N, "version": N}, "concurrency": {"exit": N,
+#    "classes": N|null, "typed_edges": N|null, "findings": N|null},
+#    "ruff": {"available": true|false, "exit": N|null},
+#    "obs": {"exit": N, "recompiles_after_warmup": N|null,
+#    "trace_spans": N|null}}
+#
+# The "concurrency" section is explicit evidence the static concurrency
+# pass (unguarded-attr / lock-order-cycle / condvar-discipline /
+# thread-lifecycle) actually ran repo-wide with the class model built:
+# a refactor that silently emptied the class database would show
+# classes=0 here and fail the gate even with zero findings.
 #
 # Everything human-readable (full reports, ruff listing) goes to stderr.
 # Exit 0 iff the gate is PASS: lint found no unsuppressed errors AND
@@ -24,6 +32,31 @@ PY=${PYTHON:-python}
 lint_json=$("$PY" -m stmgcn_tpu.cli lint --format json 2>>/dev/stderr)
 lint_exit=$?
 printf '%s\n' "$lint_json" >&2
+
+# Concurrency pass evidence: re-run the four rules standalone and
+# report the class-model scale the verdict rests on.
+conc_json=$("$PY" - <<'EOF' 2>>/dev/stderr
+import json
+import os
+
+import stmgcn_tpu
+from stmgcn_tpu.analysis.concurrency_check import check_concurrency
+from stmgcn_tpu.analysis.program_db import ProgramDB
+
+root = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
+db = ProgramDB.from_root(root, package="stmgcn_tpu", type_informed=True)
+findings = check_concurrency(db)
+for f in findings:
+    print(str(f), file=__import__("sys").stderr)
+print(json.dumps({
+    "classes": len(db.classes),
+    "typed_edges": len(db.typed_edges),
+    "findings": len(findings),
+}))
+EOF
+)
+conc_exit=$?
+printf '%s\n' "$conc_json" >&2
 
 ruff_available=false
 ruff_exit=null
@@ -77,6 +110,7 @@ obs_exit=$?
 printf '%s\n' "$obs_json" >&2
 
 LINT_JSON="$lint_json" LINT_EXIT="$lint_exit" \
+CONC_JSON="$conc_json" CONC_EXIT="$conc_exit" \
 RUFF_AVAILABLE="$ruff_available" RUFF_EXIT="$ruff_exit" \
 OBS_JSON="$obs_json" OBS_EXIT="$obs_exit" \
 "$PY" - <<'EOF'
@@ -97,8 +131,17 @@ except ValueError:
     obs = {}
 obs_exit = int(os.environ["OBS_EXIT"])
 recompiles = obs.get("recompiles_after_warmup")
+try:
+    conc = json.loads(os.environ["CONC_JSON"])
+except ValueError:
+    conc = {}
+conc_exit = int(os.environ["CONC_EXIT"])
 
 ok = lint_exit == 0 and report.get("errors") == 0
+# concurrency pass must have run over a real class model and come back
+# clean — classes == 0 means the database silently went empty
+ok = ok and conc_exit == 0 and conc.get("findings") == 0
+ok = ok and (conc.get("classes") or 0) > 0
 if ruff_available:
     ok = ok and ruff_exit == 0
 ok = ok and obs_exit == 0 and recompiles == 0
@@ -109,6 +152,12 @@ print(json.dumps({
         "errors": report.get("errors"),
         "warnings": report.get("warnings"),
         "version": report.get("version"),
+    },
+    "concurrency": {
+        "exit": conc_exit,
+        "classes": conc.get("classes"),
+        "typed_edges": conc.get("typed_edges"),
+        "findings": conc.get("findings"),
     },
     "ruff": {"available": ruff_available, "exit": ruff_exit},
     "obs": {

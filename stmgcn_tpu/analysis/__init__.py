@@ -21,7 +21,12 @@ by tier-1 (``tests/test_analysis.py``):
   inter-module calls, so a host-sync in a helper only *another*
   module's jitted code reaches is still flagged — with the cross-module
   call chain attached (``--no-whole-program`` restores the per-module
-  view).
+  view). The database also models classes (lock/condvar/thread fields,
+  type-informed dispatch through unambiguous ``__init__``/annotation
+  evidence), and :mod:`.concurrency_check` runs four concurrency rules
+  off it repo-wide: ``unguarded-attr`` (guarded-by inference with
+  cross-method chains), ``lock-order-cycle`` (global lock-acquisition
+  graph), ``condvar-discipline``, and ``thread-lifecycle``.
 - **Pass 2 — contract checks** (:mod:`.jaxpr_check`,
   :mod:`.sharding_check`, :mod:`.collective_check`,
   :mod:`.serving_check`): abstractly trace the smoke-preset step
@@ -51,6 +56,7 @@ Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 """
 
 from stmgcn_tpu.analysis.collective_check import check_collective_contracts
+from stmgcn_tpu.analysis.concurrency_check import check_concurrency
 from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
@@ -72,6 +78,7 @@ __all__ = [
     "RULES",
     "Rule",
     "check_collective_contracts",
+    "check_concurrency",
     "check_fleet_shape_classes",
     "check_obs_overhead",
     "check_pallas_kernels",

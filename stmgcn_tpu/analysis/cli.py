@@ -14,6 +14,12 @@ Exit code 1 when any *error*-severity finding survives suppression;
 warnings are reported but do not gate. The contract pass (jaxpr +
 sharding) runs only for the default whole-package target — explicit path
 arguments mean "lint this code", which contracts don't apply to.
+
+The default whole-package AST pass also runs the static concurrency
+rules (:mod:`stmgcn_tpu.analysis.concurrency_check`) repo-wide off the
+program database's class model: ``unguarded-attr``,
+``lock-order-cycle``, ``condvar-discipline``, ``thread-lifecycle``.
+``--no-whole-program`` skips them along with cross-module reachability.
 """
 
 from __future__ import annotations

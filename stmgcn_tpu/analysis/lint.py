@@ -665,10 +665,14 @@ def lint_package(
     """Lint the shipped ``stmgcn_tpu`` package (the tier-1 contract).
 
     ``whole_program=True`` (the default) first builds the repo-wide
-    program database (:mod:`.program_db`) and promotes functions that
-    are jit-reachable only through resolved cross-module calls; their
-    findings carry the root→function chain. ``whole_program=False`` is
-    the per-module escape hatch (``stmgcn lint --no-whole-program``).
+    program database (:mod:`.program_db`, with type-informed dispatch
+    resolution on) and promotes functions that are jit-reachable only
+    through resolved cross-module calls; their findings carry the
+    root→function chain. The same database then drives the four
+    concurrency rules (:mod:`.concurrency_check`) repo-wide.
+    ``whole_program=False`` is the per-module escape hatch
+    (``stmgcn lint --no-whole-program``) — no program db, no
+    concurrency pass.
     """
     if root is None:
         import stmgcn_tpu
@@ -677,9 +681,10 @@ def lint_package(
     if not whole_program:
         return lint_paths([root], include_suppressed=include_suppressed)
 
+    from stmgcn_tpu.analysis.concurrency_check import check_concurrency
     from stmgcn_tpu.analysis.program_db import ProgramDB
 
-    db = ProgramDB.from_root(root)
+    db = ProgramDB.from_root(root, type_informed=True)
     findings: List[Finding] = []
     for name, entry in sorted(db.modules.items()):
         findings.extend(
@@ -690,6 +695,10 @@ def lint_package(
                 include_suppressed=include_suppressed,
             )
         )
+    # the concurrency rules run off the same typed program database
+    findings.extend(
+        check_concurrency(db, include_suppressed=include_suppressed)
+    )
     # files the parser rejected never made it into the DB — lint them
     # per-module so the unparseable-module finding still surfaces
     indexed = {e.path for e in db.modules.values()}

@@ -31,6 +31,24 @@ view — one parse of every ``.py`` file in the package, then:
 module it returns the functions that are globally jit-reachable but
 locally invisible, with their chains. :func:`ProgramDB.cross_module_gain`
 is the acceptance-criteria view (functions only the global pass sees).
+
+Class awareness (PR 10): every module's classes are modeled as
+:class:`ClassInfo` — methods, attributes assigned in ``__init__``, and
+synchronization fields recognized from their ``threading.Lock`` /
+``RLock`` / ``Condition`` / ``Event`` / ``Thread`` / ``queue.Queue``
+constructor calls. On top of that sits the **opt-in type-informed
+resolution mode** (``type_informed=True``): ``self.method()``,
+``self.attr.method()`` where the attribute's class is unambiguous from
+``__init__``/annotation evidence, calls through a single-class-annotated
+parameter, and calls on module-level singleton instances all resolve to
+real ``module:method`` edges. The zero-false-positive contract is kept
+the same way the import resolver keeps it: a target is only resolved
+when exactly one class can be the receiver — conflicting assignments
+poison the evidence and the call stays unresolved. Edges that exist
+*only* because of typed resolution are recorded in
+:attr:`ProgramDB.typed_edges` so tests can pin the gain and its
+zero-new-findings property. The concurrency pass
+(:mod:`.concurrency_check`) consumes the same class model.
 """
 
 from __future__ import annotations
@@ -43,10 +61,80 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from stmgcn_tpu.analysis.lint import _TRACER_WRAPPERS, _ModuleIndex
 
-__all__ = ["ModuleEntry", "ProgramDB"]
+__all__ = ["ClassInfo", "ModuleEntry", "ProgramDB"]
 
 #: re-export chains longer than this are a cycle, not a design
 _MAX_ALIAS_DEPTH = 8
+
+#: constructor dotted path -> synchronization-field kind
+_SYNC_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Condition": "condvar",
+    "threading.Event": "event",
+    "threading.Thread": "thread",
+    "threading.Timer": "thread",
+    "queue.Queue": "queue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "queue.SimpleQueue": "queue",
+}
+
+
+def _dotted_expr(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name-rooted attribute chain; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` when ``node`` is exactly ``self.X``; None otherwise."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """One class: methods, ``self`` attributes, typed synchronization
+    fields, and the attribute types that are unambiguous from
+    ``__init__``/annotation evidence (the dispatch-resolution basis)."""
+
+    qualname: str  # "module:Class"
+    module: str
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    attrs: Set[str] = dataclasses.field(default_factory=set)
+    locks: Set[str] = dataclasses.field(default_factory=set)
+    #: condvar field -> owning lock field (None = owns its own lock)
+    condvars: Dict[str, Optional[str]] = dataclasses.field(default_factory=dict)
+    events: Set[str] = dataclasses.field(default_factory=set)
+    queues: Set[str] = dataclasses.field(default_factory=set)
+    #: thread field -> daemon flag (None = not statically knowable)
+    threads: Dict[str, Optional[bool]] = dataclasses.field(default_factory=dict)
+    #: attr -> "module:Class" — only when exactly one class is possible
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def sync_fields(self) -> Set[str]:
+        return (
+            self.locks
+            | set(self.condvars)
+            | self.events
+            | self.queues
+            | set(self.threads)
+        )
 
 
 @dataclasses.dataclass
@@ -97,16 +185,32 @@ def _module_imports(
 class ProgramDB:
     """Module graph + resolved aliases + global jit-reachability."""
 
-    def __init__(self, entries: Dict[str, ModuleEntry]):
+    def __init__(
+        self, entries: Dict[str, ModuleEntry], *, type_informed: bool = False
+    ):
         self.modules = entries
+        self.type_informed = type_informed
         self.roots: Set[str] = set()
         self.edges: Dict[str, Set[str]] = {}
+        #: "module:Class" -> ClassInfo, for every class in every module
+        self.classes: Dict[str, ClassInfo] = {}
+        #: module -> {global name -> "module:Class"} singleton instances
+        self._globals: Dict[str, Dict[str, str]] = {}
+        #: (caller, callee) edges that exist only via typed resolution
+        self.typed_edges: Set[Tuple[str, str]] = set()
+        self._build_classes()
         self._build_graph()
         self._reach: Optional[Dict[str, Tuple[str, ...]]] = None
 
     # -- construction ------------------------------------------------------
     @classmethod
-    def from_root(cls, root: str, package: Optional[str] = None) -> "ProgramDB":
+    def from_root(
+        cls,
+        root: str,
+        package: Optional[str] = None,
+        *,
+        type_informed: bool = False,
+    ) -> "ProgramDB":
         """Parse every ``.py`` under ``root`` (a package directory)."""
         root_path = Path(root)
         package = package or root_path.name
@@ -125,10 +229,12 @@ class ProgramDB:
             entry = cls._entry(name, rel, source, is_package)
             if entry is not None:
                 entries[name] = entry
-        return cls(entries)
+        return cls(entries, type_informed=type_informed)
 
     @classmethod
-    def from_sources(cls, sources: Dict[str, str]) -> "ProgramDB":
+    def from_sources(
+        cls, sources: Dict[str, str], *, type_informed: bool = False
+    ) -> "ProgramDB":
         """Build from ``{dotted module name: source}`` (test fixtures)."""
         entries: Dict[str, ModuleEntry] = {}
         for name, src in sources.items():
@@ -136,7 +242,7 @@ class ProgramDB:
             entry = cls._entry(name, path, src, is_package=False)
             if entry is not None:
                 entries[name] = entry
-        return cls(entries)
+        return cls(entries, type_informed=type_informed)
 
     @staticmethod
     def _entry(
@@ -190,6 +296,279 @@ class ProgramDB:
             return None
         full = f"{target}.{rest}" if rest else target
         return self.resolve_symbol(full)
+
+    # -- class modeling ----------------------------------------------------
+    def _build_classes(self) -> None:
+        # phase A: shells first, so cross-module class references resolve
+        # whatever the module iteration order
+        for name, entry in self.modules.items():
+            for node in entry.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    qual = f"{name}:{node.name}"
+                    ci = ClassInfo(
+                        qualname=qual, module=name, name=node.name, node=node
+                    )
+                    for item in node.body:
+                        if isinstance(
+                            item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            ci.methods[item.name] = item
+                    self.classes[qual] = ci
+        # phase B: field analysis (needs resolve_class over the shells)
+        for name, entry in self.modules.items():
+            for qual, ci in list(self.classes.items()):
+                if ci.module == name:
+                    self._analyze_fields(entry, ci)
+            self._globals[name] = self._module_globals(entry)
+
+    def _abs_ctor(self, entry: ModuleEntry, func: ast.AST) -> Optional[str]:
+        """Absolute dotted path of a call's constructor through the
+        import map (``Condition`` -> ``threading.Condition``)."""
+        d = _dotted_expr(func)
+        if d is None:
+            return None
+        root, _, rest = d.partition(".")
+        base = entry.imports.get(root, root)
+        return f"{base}.{rest}" if rest else base
+
+    def resolve_class(self, dotted: str, _depth: int = 0) -> Optional[str]:
+        """Absolute dotted path -> ``module:Class`` qualname, following
+        re-export chains; None when it doesn't land on a known class."""
+        if _depth > _MAX_ALIAS_DEPTH:
+            return None
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod not in self.modules:
+                continue
+            rest = parts[i:]
+            if len(rest) != 1:
+                return None
+            sym = rest[0]
+            if f"{mod}:{sym}" in self.classes:
+                return f"{mod}:{sym}"
+            imports = self.modules[mod].imports
+            if sym in imports:
+                return self.resolve_class(imports[sym], _depth + 1)
+            return None
+        return None
+
+    def _annotation_class(
+        self, entry: ModuleEntry, ann: Optional[ast.AST]
+    ) -> Optional[str]:
+        """``module:Class`` named by an annotation; ``Optional[X]``
+        unwraps to ``X``; anything else ambiguous returns None."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Subscript):
+            base = _dotted_expr(ann.value)
+            if base and base.split(".")[-1] == "Optional":
+                return self._annotation_class(entry, ann.slice)
+            return None
+        d = _dotted_expr(ann)
+        if d is None:
+            return None
+        if "." not in d and f"{entry.name}:{d}" in self.classes:
+            return f"{entry.name}:{d}"
+        root, _, rest = d.partition(".")
+        base = entry.imports.get(root)
+        if base is None:
+            return None
+        return self.resolve_class(f"{base}.{rest}" if rest else base)
+
+    def _called_class(
+        self, entry: ModuleEntry, value: ast.AST
+    ) -> Optional[str]:
+        """``module:Class`` when ``value`` is a direct constructor call."""
+        if not isinstance(value, ast.Call):
+            return None
+        d = self._abs_ctor(entry, value.func)
+        if d is None or d in _SYNC_CTORS:
+            return None
+        if "." not in d and f"{entry.name}:{d}" in self.classes:
+            return f"{entry.name}:{d}"
+        return self.resolve_class(d)
+
+    def _analyze_fields(self, entry: ModuleEntry, ci: ClassInfo) -> None:
+        init = ci.methods.get("__init__")
+        init_params: Dict[str, Optional[ast.AST]] = {}
+        if init is not None:
+            args = init.args
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                init_params[a.arg] = a.annotation
+        evidence: Dict[str, Set[str]] = {}  # attr -> candidate class quals
+        poisoned: Set[str] = set()  # attrs with a non-None untyped (re)assign
+        for mname, method in ci.methods.items():
+            for node in ast.walk(method):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    ci.attrs.add(attr)
+                    if isinstance(node, ast.AnnAssign):
+                        t_cls = self._annotation_class(entry, node.annotation)
+                        if t_cls is not None:
+                            evidence.setdefault(attr, set()).add(t_cls)
+                        if value is None:
+                            continue
+                    kind = (
+                        _SYNC_CTORS.get(self._abs_ctor(entry, value.func))
+                        if isinstance(value, ast.Call)
+                        else None
+                    )
+                    if kind == "lock":
+                        ci.locks.add(attr)
+                    elif kind == "condvar":
+                        owner = None
+                        if value.args:
+                            owner = _self_attr(value.args[0])
+                        ci.condvars[attr] = owner
+                    elif kind == "event":
+                        ci.events.add(attr)
+                    elif kind == "queue":
+                        ci.queues.add(attr)
+                    elif kind == "thread":
+                        daemon: Optional[bool] = False
+                        for kw in value.keywords:
+                            if kw.arg == "daemon":
+                                daemon = (
+                                    kw.value.value
+                                    if isinstance(kw.value, ast.Constant)
+                                    and isinstance(kw.value.value, bool)
+                                    else None
+                                )
+                        ci.threads[attr] = daemon
+                    else:
+                        t_cls = self._called_class(entry, value)
+                        if t_cls is None and (
+                            mname == "__init__"
+                            and isinstance(value, ast.Name)
+                            and value.id in init_params
+                        ):
+                            t_cls = self._annotation_class(
+                                entry, init_params[value.id]
+                            )
+                        if t_cls is not None:
+                            evidence.setdefault(attr, set()).add(t_cls)
+                        elif not (
+                            isinstance(value, ast.Constant)
+                            and value.value is None
+                        ):
+                            # a real untyped (re)assignment: the attribute's
+                            # class is no longer unambiguous (None keeps the
+                            # Optional[field] idiom typed)
+                            poisoned.add(attr)
+        for attr, cands in evidence.items():
+            if len(cands) == 1 and attr not in poisoned:
+                ci.attr_types[attr] = next(iter(cands))
+
+    def _module_globals(self, entry: ModuleEntry) -> Dict[str, str]:
+        """Module-level ``NAME = SomeClass()`` singleton instances."""
+        out: Dict[str, str] = {}
+        for node in entry.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            t_cls = self._called_class(entry, node.value)
+            if t_cls is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = t_cls
+        return out
+
+    def instance_type(
+        self, entry: ModuleEntry, name: str, _depth: int = 0
+    ) -> Optional[str]:
+        """``module:Class`` of a bare name that statically names a
+        module-level singleton (local or imported); None otherwise."""
+        if _depth > _MAX_ALIAS_DEPTH:
+            return None
+        local = self._globals.get(entry.name, {}).get(name)
+        if local is not None:
+            return local
+        dotted = entry.imports.get(name)
+        if dotted is None:
+            return None
+        mod, _, sym = dotted.rpartition(".")
+        if mod in self.modules:
+            target = self._globals.get(mod, {}).get(sym)
+            if target is not None:
+                return target
+            if sym in self.modules[mod].imports:
+                return self.instance_type(self.modules[mod], sym, _depth + 1)
+        return None
+
+    def receiver_type(
+        self,
+        entry: ModuleEntry,
+        cls_qual: Optional[str],
+        fn_node: Optional[ast.AST],
+        recv: ast.AST,
+    ) -> Optional[str]:
+        """``module:Class`` of a call receiver expression, using only
+        unambiguous evidence: ``self`` inside a known class, ``self.attr``
+        with a single-class attr type, a single-class-annotated parameter
+        of the enclosing function (unless locally reassigned), or a
+        module-level singleton instance."""
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                return cls_qual
+            if fn_node is not None:
+                args = fn_node.args
+                for a in args.posonlyargs + args.args + args.kwonlyargs:
+                    if a.arg == recv.id:
+                        if a.annotation is None or self._locally_bound(
+                            fn_node, recv.id
+                        ):
+                            return None
+                        return self._annotation_class(entry, a.annotation)
+                if self._locally_bound(fn_node, recv.id):
+                    return None
+            return self.instance_type(entry, recv.id)
+        attr = _self_attr(recv)
+        if attr is not None and cls_qual is not None:
+            ci = self.classes.get(cls_qual)
+            if ci is not None:
+                return ci.attr_types.get(attr)
+        return None
+
+    @staticmethod
+    def _locally_bound(fn_node: ast.AST, name: str) -> bool:
+        for sub in ast.walk(fn_node):
+            if (
+                isinstance(sub, ast.Name)
+                and sub.id == name
+                and isinstance(sub.ctx, (ast.Store, ast.Del))
+            ):
+                return True
+        return False
+
+    def typed_method_target(
+        self,
+        entry: ModuleEntry,
+        cls_qual: Optional[str],
+        fn_node: Optional[ast.AST],
+        call: ast.Call,
+    ) -> Optional[Tuple[str, str]]:
+        """``("module:Class", method)`` for ``obj.m(...)`` when the
+        receiver's class is unambiguous and defines ``m``; else None."""
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        t = self.receiver_type(entry, cls_qual, fn_node, call.func.value)
+        if t is None:
+            return None
+        ci = self.classes.get(t)
+        if ci is None or call.func.attr not in ci.methods:
+            return None
+        return t, call.func.attr
 
     # -- the global graph --------------------------------------------------
     def _build_graph(self) -> None:
@@ -272,14 +651,23 @@ class _GraphWalker(ast.NodeVisitor):
         self.db = db
         self.entry = entry
         self._stack: List[str] = []
+        self._fn_nodes: List[ast.AST] = []
+        self._cls: List[str] = []
 
     def _handle_func(self, node) -> None:
         self._stack.append(node.name)
+        self._fn_nodes.append(node)
         self.generic_visit(node)
+        self._fn_nodes.pop()
         self._stack.pop()
 
     visit_FunctionDef = _handle_func
     visit_AsyncFunctionDef = _handle_func
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._cls.append(f"{self.entry.name}:{node.name}")
+        self.generic_visit(node)
+        self._cls.pop()
 
     def _add_edge(self, callee_q: str) -> None:
         if self._stack and callee_q in self.db.edges:
@@ -304,6 +692,26 @@ class _GraphWalker(ast.NodeVisitor):
                 target = f"{entry.name}:{node.func.attr}"
         if target is not None:
             self._add_edge(target)
+
+        # opt-in type-informed dispatch: obj.m() resolves through the
+        # class model when the receiver class is unambiguous; edges that
+        # only exist this way are recorded for the acceptance pin
+        if self.db.type_informed and isinstance(node.func, ast.Attribute):
+            tm = self.db.typed_method_target(
+                entry,
+                self._cls[-1] if self._cls else None,
+                self._fn_nodes[-1] if self._fn_nodes else None,
+                node,
+            )
+            if tm is not None:
+                callee_q = f"{tm[0].split(':', 1)[0]}:{tm[1]}"
+                if callee_q != target and self._stack:
+                    caller_q = f"{entry.name}:{self._stack[-1]}"
+                    if callee_q in self.db.edges and callee_q not in (
+                        self.db.edges.get(caller_q, set())
+                    ):
+                        self.db.typed_edges.add((caller_q, callee_q))
+                    self._add_edge(callee_q)
 
         # an *imported* function handed to a tracing transform becomes a
         # global root — the seed no per-module index can plant

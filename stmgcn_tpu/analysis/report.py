@@ -17,8 +17,11 @@ __all__ = ["Finding", "render_json", "render_text"]
 
 #: bumped when the JSON report shape or rule ids change incompatibly
 #: (v2: whole-program lint — findings carry ``chain``/``suppressed``,
-#: counts exclude suppressed findings)
-REPORT_VERSION = 2
+#: counts exclude suppressed findings; v3: concurrency rules —
+#: unguarded-attr / lock-order-cycle / condvar-discipline /
+#: thread-lifecycle run in lint_package's default whole-program mode,
+#: chains may now be cross-method, not only jit-reachability)
+REPORT_VERSION = 3
 
 
 @dataclasses.dataclass(frozen=True)

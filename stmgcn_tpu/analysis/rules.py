@@ -77,6 +77,36 @@ _ALL_RULES = [
         "a loop body — each call (or iteration) presents a new identity "
         "and silently retraces",
     ),
+    # -- pass 1b: static concurrency analysis ----------------------------
+    Rule(
+        "unguarded-attr",
+        "error",
+        "an attribute written under `with self._lock` in one method is "
+        "read/written lock-free in another method of the same class — a "
+        "data race; the finding carries the guarding-writer -> lock-free-"
+        "access chain",
+    ),
+    Rule(
+        "lock-order-cycle",
+        "error",
+        "the global lock-acquisition graph (built across modules through "
+        "the type-informed call graph) contains a cycle — two threads "
+        "taking the locks in opposite orders deadlock",
+    ),
+    Rule(
+        "condvar-discipline",
+        "error",
+        "Condition.wait() outside a while-predicate loop (spurious "
+        "wakeup / missed notify), or wait/notify without the condvar's "
+        "owning lock held (RuntimeError at runtime)",
+    ),
+    Rule(
+        "thread-lifecycle",
+        "error",
+        "a non-daemon Thread started with no reachable join()/cancel() "
+        "path (shutdown hangs on it), or a blocking call (queue.get/put, "
+        "sleep, join, Event.wait, device sync) made while holding a lock",
+    ),
     # -- pass 2: jaxpr / sharding contracts ------------------------------
     Rule(
         "fp64-promotion",

@@ -57,7 +57,8 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def reset(self) -> None:
         with self._lock:
@@ -126,11 +127,13 @@ class Reservoir:
     @property
     def count(self) -> int:
         """All-time samples recorded (>= len(samples()) once evicting)."""
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def total(self) -> float:
-        return self._total
+        with self._lock:
+            return self._total
 
     def samples(self) -> List[float]:
         with self._lock:

@@ -210,12 +210,16 @@ class Tracer:
     def export_jsonl(self, path: str) -> int:
         """Write the ring as JSONL: a ``meta`` header line then one
         JSON object per span. Returns the number of spans written."""
-        spans = self.spans()
+        with self._lock:
+            # one critical section for ring + dropped: the header's
+            # dropped count stays consistent with the spans it describes
+            spans = list(self._ring)
+            dropped = self.dropped
         meta = {
             "schema_version": SCHEMA_VERSION,
             "kind": "meta",
             "capacity": self.capacity,
-            "dropped": self.dropped,
+            "dropped": dropped,
             "spans": len(spans),
         }
         with open(path, "w") as f:

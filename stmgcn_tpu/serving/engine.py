@@ -201,11 +201,28 @@ class CheckpointWatcher:
         self._applied_mtime = mtime
         return True
 
-    def stop(self) -> None:
+    #: stop() waits this long for an in-flight poll before detaching
+    JOIN_TIMEOUT_S = 5.0
+
+    def stop(self, timeout_s: Optional[float] = None) -> bool:
+        """Signal the poll loop and join it, bounded by ``timeout_s``
+        (default :attr:`JOIN_TIMEOUT_S`).
+
+        Returns True when the thread exited within the timeout; False
+        when an in-flight ``poll()`` is still finishing. Either way the
+        stop event guarantees no *further* scans, and the thread is
+        daemon, so a straggler cannot hold the process open — close()
+        must never deadlock behind slow checkpoint IO.
+        """
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        t = self._thread
+        if t is None:
+            return True
+        t.join(self.JOIN_TIMEOUT_S if timeout_s is None else timeout_s)
+        if t.is_alive():
+            return False
+        self._thread = None
+        return True
 
 
 class ServingEngine:

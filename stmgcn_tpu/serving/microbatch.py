@@ -135,7 +135,8 @@ class MicroBatcher:
     @property
     def wedged(self) -> bool:
         """Whether the worker thread has died (submits now fail fast)."""
-        return self._dead is not None
+        with self._lock:
+            return self._dead is not None
 
     def submit(self, rows: np.ndarray, tag=None, *, with_info: bool = False):
         """Enqueue one request and block until its predictions are ready.

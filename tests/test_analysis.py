@@ -554,7 +554,7 @@ class TestReport:
     def test_json_shape_stable(self):
         payload = json.loads(render_json([]))
         assert payload == {
-            "version": 2, "errors": 0, "warnings": 0, "findings": [],
+            "version": 3, "errors": 0, "warnings": 0, "findings": [],
         }
 
     def test_finding_records_carry_chain_and_suppressed(self):
@@ -1446,6 +1446,458 @@ class TestWholeProgramSuppression:
         assert payload["findings"][0]["suppressed"] is True
 
 
+def _line_of(src, snippet):
+    """1-based line of the unique source line containing ``snippet`` —
+    pins a finding to its exact boundary without hand-counted numbers."""
+    hits = [i for i, ln in enumerate(src.splitlines(), 1) if snippet in ln]
+    assert len(hits) == 1, (snippet, hits)
+    return hits[0]
+
+
+_UNGUARDED_SRC = textwrap.dedent(
+    """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def read(self):
+            return self._n
+    """
+)
+
+_CONDVAR_SRC = textwrap.dedent(
+    """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._items = []
+
+        def put(self, x):
+            with self._cond:
+                self._items.append(x)
+                self._cond.notify()
+
+        def get_good(self):
+            with self._cond:
+                while not self._items:
+                    self._cond.wait()
+                return self._items.pop()
+    """
+)
+
+_THREAD_SRC = textwrap.dedent(
+    """
+    import threading
+    import time
+
+    class Worker:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run)
+
+        def start(self):
+            self._t.start()
+
+        def _run(self):
+            pass
+    """
+)
+
+# the two-module deadlock shape: each class calls into the *other*
+# module's singleton while holding its own lock — only resolvable
+# through the class model (module-global instance types)
+_CYCLE_A = textwrap.dedent(
+    """
+    import threading
+
+    from pkg.b import OTHER
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def cross(self):
+            with self._lock:
+                OTHER.poke()
+
+    ROOT = A()
+    """
+)
+
+_CYCLE_B = textwrap.dedent(
+    """
+    import threading
+
+    from pkg.a import ROOT
+
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def cross(self):
+            with self._lock:
+                ROOT.poke()
+
+    OTHER = B()
+    """
+)
+
+
+class TestClassModel:
+    """program_db's class awareness: the facts the concurrency rules
+    consume (sync fields, condvar owners, type evidence)."""
+
+    def test_sync_fields_and_attr_types(self):
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        db = ProgramDB.from_sources({
+            "pkg.m": textwrap.dedent(
+                """
+                import threading
+                import queue
+
+                class Stats:
+                    def __init__(self):
+                        self.n = 0
+
+                class Engine:
+                    def __init__(self, poll):
+                        self._lock = threading.Lock()
+                        self._cond = threading.Condition(self._lock)
+                        self._q = queue.Queue()
+                        self._t = threading.Thread(target=self._run,
+                                                   daemon=True)
+                        self._stats = Stats()
+
+                    def _run(self):
+                        pass
+                """
+            ),
+        })
+        ci = db.classes["pkg.m:Engine"]
+        assert ci.locks == {"_lock"}
+        assert ci.condvars == {"_cond": "_lock"}
+        assert ci.queues == {"_q"}
+        assert ci.threads == {"_t": True}  # daemon kwarg captured
+        assert ci.attr_types == {"_stats": "pkg.m:Stats"}
+        assert set(ci.methods) == {"__init__", "_run"}
+
+    def test_conflicting_assignment_poisons_type(self):
+        """zero-false-positive contract: an attr assigned two different
+        ways is *untyped*, not guessed."""
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        db = ProgramDB.from_sources({
+            "pkg.m": textwrap.dedent(
+                """
+                class Stats:
+                    pass
+
+                class Engine:
+                    def __init__(self, stats):
+                        self._stats = Stats()
+
+                    def attach(self, other):
+                        self._stats = other
+                """
+            ),
+        })
+        assert db.classes["pkg.m:Engine"].attr_types == {}
+
+    def test_optional_none_assignment_keeps_type(self):
+        """the ``self._t = None`` / later ``self._t = Thread(...)``
+        idiom stays a thread field — None never poisons; no daemon
+        kwarg pins the ``threading.Thread`` default (non-daemon)."""
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        db = ProgramDB.from_sources({
+            "pkg.m": textwrap.dedent(
+                """
+                import threading
+
+                class W:
+                    def __init__(self):
+                        self._t = None
+
+                    def go(self):
+                        self._t = threading.Thread(target=self.go)
+                """
+            ),
+        })
+        assert db.classes["pkg.m:W"].threads == {"_t": False}
+
+
+class TestConcurrencyRules:
+    """Each rule's fire/pass boundary on a seeded fixture (satellite c)."""
+
+    def _run(self, sources, **kw):
+        from stmgcn_tpu.analysis.concurrency_check import check_concurrency
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        return check_concurrency(
+            ProgramDB.from_sources(sources, type_informed=True), **kw)
+
+    def test_all_four_rules_registered_as_errors(self):
+        for rule in ("unguarded-attr", "lock-order-cycle",
+                     "condvar-discipline", "thread-lifecycle"):
+            assert RULES[rule].severity == "error"
+
+    def test_unguarded_read_fires_with_cross_method_chain(self):
+        f = self._run({"pkg.box": _UNGUARDED_SRC})
+        assert [(x.rule, x.line) for x in f] == [
+            ("unguarded-attr", _line_of(_UNGUARDED_SRC, "return self._n")),
+        ]
+        assert f[0].chain == ("pkg.box:Box.bump", "pkg.box:Box.read")
+        assert "`self._n`" in f[0].message and "`self._lock`" in f[0].message
+
+    def test_guarded_twin_is_clean(self):
+        guarded = _UNGUARDED_SRC.replace(
+            "def read(self):\n        return self._n",
+            "def read(self):\n        with self._lock:\n"
+            "            return self._n",
+        )
+        assert self._run({"pkg.box": guarded}) == []
+
+    def test_wait_outside_while_fires(self):
+        bad = _CONDVAR_SRC.replace(
+            "            while not self._items:\n"
+            "                self._cond.wait()",
+            "            self._cond.wait()  # BAD",
+        )
+        f = self._run({"pkg.q": bad})
+        assert [(x.rule, x.line) for x in f] == [
+            ("condvar-discipline", _line_of(bad, "# BAD")),
+        ]
+        assert "while" in f[0].message
+
+    def test_notify_outside_owning_lock_fires(self):
+        bad = _CONDVAR_SRC.replace(
+            "    def put(self, x):",
+            "    def kick(self):\n"
+            "        self._cond.notify()  # BAD\n\n"
+            "    def put(self, x):",
+        )
+        f = self._run({"pkg.q": bad})
+        assert [(x.rule, x.line) for x in f] == [
+            ("condvar-discipline", _line_of(bad, "# BAD")),
+        ]
+        assert "owning lock" in f[0].message
+
+    def test_condvar_discipline_twin_is_clean(self):
+        assert self._run({"pkg.q": _CONDVAR_SRC}) == []
+
+    def test_started_nonjoined_thread_fires(self):
+        f = self._run({"pkg.w": _THREAD_SRC})
+        assert [(x.rule, x.line) for x in f] == [
+            ("thread-lifecycle", _line_of(_THREAD_SRC, "self._t.start()")),
+        ]
+        assert "non-daemon" in f[0].message
+
+    def test_daemon_and_joined_twins_are_clean(self):
+        daemon = _THREAD_SRC.replace(
+            "threading.Thread(target=self._run)",
+            "threading.Thread(target=self._run, daemon=True)",
+        )
+        joined = _THREAD_SRC.replace(
+            "    def _run(self):",
+            "    def stop(self):\n"
+            "        self._t.join()\n\n"
+            "    def _run(self):",
+        )
+        assert self._run({"pkg.w": daemon}) == []
+        assert self._run({"pkg.w": joined}) == []
+
+    def test_blocking_call_under_lock_fires(self):
+        src = textwrap.dedent(
+            """
+            import threading
+            import time
+
+            class Sleeper:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(1)
+            """
+        )
+        f = self._run({"pkg.s": src})
+        assert [(x.rule, x.line) for x in f] == [
+            ("thread-lifecycle", _line_of(src, "time.sleep(1)")),
+        ]
+        assert "holding `_lock`" in f[0].message
+
+    def test_two_module_lock_order_cycle(self):
+        srcs = {"pkg.a": _CYCLE_A, "pkg.b": _CYCLE_B}
+        f = self._run(srcs)
+        assert [x.rule for x in f] == ["lock-order-cycle"]
+        assert f[0].path == "pkg/a.py"
+        assert f[0].line == _line_of(_CYCLE_A, "OTHER.poke()")
+        assert f[0].chain == ("pkg.a:A.cross", "pkg.b:B.cross")
+        # both halves of the inversion are named with their sites
+        assert "pkg/a.py:" in f[0].message and "pkg/b.py:" in f[0].message
+        assert "pkg.a:A._lock -> pkg.b:B._lock -> pkg.a:A._lock" \
+            in f[0].message
+
+    def test_consistent_order_twin_is_clean(self):
+        b_ok = _CYCLE_B.replace(
+            "    def cross(self):\n"
+            "        with self._lock:\n"
+            "            ROOT.poke()",
+            "    def cross(self):\n"
+            "        ROOT.poke()",
+        )
+        assert self._run({"pkg.a": _CYCLE_A, "pkg.b": b_ok}) == []
+
+    def test_cycle_needs_class_model_singleton_typing(self):
+        """the cycle's inter-module edges exist only through the class
+        model's module-global instance typing — the pre-class-model call
+        graph never records them."""
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        db = ProgramDB.from_sources(
+            {"pkg.a": _CYCLE_A, "pkg.b": _CYCLE_B}, type_informed=True)
+        assert db.typed_edges == {
+            ("pkg.a:cross", "pkg.b:poke"),
+            ("pkg.b:cross", "pkg.a:poke"),
+        }
+        db0 = ProgramDB.from_sources(
+            {"pkg.a": _CYCLE_A, "pkg.b": _CYCLE_B}, type_informed=False)
+        assert db0.typed_edges == set()
+
+
+class TestTypeInformedOnTree:
+    """Acceptance pins for type-informed resolution on the real tree."""
+
+    def _db(self, **kw):
+        import os
+
+        import stmgcn_tpu
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        root = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
+        return ProgramDB.from_root(root, package="stmgcn_tpu", **kw)
+
+    def test_resolves_previously_unresolved_edges(self):
+        db = self._db(type_informed=True)
+        assert len(db.typed_edges) >= 10
+        # every typed edge is NEW information: absent from the untyped
+        # graph by construction, and lands on a real known function
+        for caller, callee in db.typed_edges:
+            assert callee in db.edges
+        # the singleton-typed edge the jit-reachability pass gains:
+        # jaxmon's REGISTRY.counter(...) through the module-global's
+        # inferred MetricsRegistry type
+        assert ("stmgcn_tpu.obs.jaxmon:_refresh_recompiles",
+                "stmgcn_tpu.obs.registry:counter") in db.typed_edges
+
+    def test_zero_new_findings_on_tree(self):
+        from stmgcn_tpu.analysis.concurrency_check import check_concurrency
+
+        typed = check_concurrency(self._db(type_informed=True))
+        untyped = check_concurrency(self._db(type_informed=False))
+        assert typed == []  # the tree is clean under the deeper graph
+        assert untyped == []
+
+    def test_tree_class_model_sees_serving_sync_fields(self):
+        db = self._db(type_informed=True)
+        mb = db.classes["stmgcn_tpu.serving.microbatch:MicroBatcher"]
+        assert "_lock" in mb.locks
+        assert mb.condvars.get("_cond") == "_lock"
+        assert "_worker" in mb.threads
+
+
+class TestConcurrencySuppression:
+    """Cross-method findings suppress at the *reported* access line
+    (satellite f); --include-suppressed lists, never counts."""
+
+    def _suppressed_src(self):
+        return _UNGUARDED_SRC.replace(
+            "return self._n",
+            "return self._n  # stmgcn: ignore[unguarded-attr]",
+        )
+
+    def test_suppress_at_reported_line(self):
+        from stmgcn_tpu.analysis.concurrency_check import check_concurrency
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        db = ProgramDB.from_sources({"pkg.box": self._suppressed_src()})
+        assert check_concurrency(db) == []
+
+    def test_suppress_at_write_site_does_not_apply(self):
+        """the guard evidence line is not the finding line — suppression
+        there must NOT silence the read-side finding."""
+        from stmgcn_tpu.analysis.concurrency_check import check_concurrency
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        src = _UNGUARDED_SRC.replace(
+            "self._n += 1",
+            "self._n += 1  # stmgcn: ignore[unguarded-attr]",
+        )
+        db = ProgramDB.from_sources({"pkg.box": src})
+        assert [f.rule for f in check_concurrency(db)] == ["unguarded-attr"]
+
+    def test_include_suppressed_lists_but_never_counts(self):
+        from stmgcn_tpu.analysis.concurrency_check import check_concurrency
+        from stmgcn_tpu.analysis.program_db import ProgramDB
+
+        db = ProgramDB.from_sources({"pkg.box": self._suppressed_src()})
+        f = check_concurrency(db, include_suppressed=True)
+        assert [x.rule for x in f] == ["unguarded-attr"]
+        assert f[0].suppressed is True
+        assert f[0].chain == ("pkg.box:Box.bump", "pkg.box:Box.read")
+        payload = json.loads(render_json(f))
+        assert payload["errors"] == 0 and payload["warnings"] == 0
+        assert payload["findings"][0]["suppressed"] is True
+
+
+@pytest.mark.slow
+class TestLintWallTime:
+    """The whole-program pass stays fast enough to gate every commit:
+    one full ``stmgcn lint`` (AST + class model + concurrency +
+    contracts) under a wall-time budget with wide headroom (satellite e;
+    measured ~7s on the dev box)."""
+
+    BUDGET_S = 60.0
+
+    def test_full_lint_under_budget(self):
+        import os
+        import subprocess
+        import sys
+        import time as _time
+
+        t0 = _time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "stmgcn_tpu.cli", "lint",
+             "--format", "json"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        elapsed = _time.monotonic() - t0
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        payload = json.loads(proc.stdout)
+        assert payload["errors"] == 0 and payload["warnings"] == 0
+        assert elapsed < self.BUDGET_S, f"lint took {elapsed:.1f}s"
+
+
 class TestBranchBandwidthFloor:
     """Satellite b: a-priori floors for the data-dependent branches."""
 
@@ -1515,8 +1967,14 @@ class TestLintGateScript:
         payload = json.loads(lines[0])
         assert payload["gate"] == "PASS"
         assert payload["lint"] == {
-            "exit": 0, "errors": 0, "warnings": 0, "version": 2,
+            "exit": 0, "errors": 0, "warnings": 0, "version": 3,
         }
+        # concurrency evidence: the pass ran over a real class model,
+        # gained typed edges, and found nothing unsuppressed
+        assert payload["concurrency"]["exit"] == 0
+        assert payload["concurrency"]["findings"] == 0
+        assert payload["concurrency"]["classes"] > 0
+        assert payload["concurrency"]["typed_edges"] > 0
         assert set(payload["ruff"]) == {"available", "exit"}
         # the traced smoke run: compiled fine, traced spans, and — the
         # dynamic recompile gate — NOTHING compiled after warmup

@@ -643,3 +643,33 @@ def test_checkpoint_watcher_quarantines_then_recovers(setup, tmp_path):
         np.testing.assert_array_equal(out, ref1)
     finally:
         eng.close()
+
+
+def test_watcher_close_during_inflight_poll_does_not_deadlock(setup, tmp_path):
+    """The lifecycle contract the thread-lifecycle lint rule assumes:
+    stop()/close() join the watcher thread with a *bounded* timeout, so
+    a poll wedged in slow checkpoint IO cannot hang shutdown."""
+    fc, supports, _ = setup
+    eng = fc.serving_engine(supports, config=LADDER)
+    try:
+        watcher = eng.watch_checkpoints(str(tmp_path), poll_s=0.01)
+        entered = threading.Event()
+        release = threading.Event()
+
+        def wedged_poll():
+            entered.set()
+            release.wait(timeout=30)
+            return False
+
+        watcher.poll = wedged_poll  # next loop iteration blocks in "IO"
+        assert entered.wait(timeout=10)  # a poll is now in flight
+        t0 = time.monotonic()
+        assert watcher.stop(timeout_s=0.2) is False  # wedged, but bounded
+        assert time.monotonic() - t0 < 5.0  # returned promptly, no deadlock
+        release.set()  # the wedged IO finally completes
+        assert watcher._thread is not None
+        watcher._thread.join(timeout=10)
+        assert not watcher._thread.is_alive()  # stop event ends the loop
+        assert watcher.stop(timeout_s=0.2) is True  # idempotent once dead
+    finally:
+        eng.close()  # close hook after stop(): still clean, no hang

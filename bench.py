@@ -412,6 +412,109 @@ def _measure_superstep(dtype: str, warmup: int, iters: int, s_steps: int) -> dic
     return leg
 
 
+def _health_rider() -> dict:
+    """Numeric-health rider: the fused superstep with on-device health
+    statistics (grad/update norms, nonfinite counts, per-group norms) as
+    extra scan outputs vs the plain superstep at the same smoke-scale
+    shapes — wall overhead of ``every_k=1`` instrumentation plus
+    bit-parity of the trained params. Smoke shapes on purpose: the
+    contract under test is "cheap enough to leave on" (<3% wall) and
+    "bit-identical when on", not canonical throughput; the canonical
+    legs above stay un-instrumented."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+    from stmgcn_tpu.models import STMGCN
+    from stmgcn_tpu.ops import SupportConfig
+    from stmgcn_tpu.train import (
+        make_optimizer,
+        make_series_superstep_fns,
+        make_step_fns,
+    )
+    from stmgcn_tpu.utils import time_chained
+
+    s_steps, batch = 4, 8
+    data = synthetic_dataset(rows=5, n_timesteps=24 * 7 * 2 + 4 * batch, seed=0)
+    dataset = DemandDataset(data, WindowSpec(SERIAL, DAILY, WEEKLY, 24))
+    supports = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(
+        m_graphs=M_GRAPHS, n_supports=K_SUPPORTS,
+        seq_len=SERIAL + DAILY + WEEKLY, input_dim=dataset.n_feats,
+        lstm_hidden_dim=16, lstm_num_layers=1, gcn_hidden_dim=16,
+    )
+    opt = make_optimizer(2e-3, 1e-4)
+    fns = make_step_fns(model, opt, "mse")
+    horizon = dataset.window.horizon
+    plain = make_series_superstep_fns(model, opt, "mse", horizon=horizon)
+    instr = make_series_superstep_fns(
+        model, opt, "mse", horizon=horizon, health=True
+    )
+
+    series = jnp.asarray(dataset.series_stack())
+    targets = jnp.asarray(dataset.mode_targets("train"))
+    offsets = jnp.asarray(np.asarray(dataset.window.offsets, np.int32))
+    index_rows = [
+        np.asarray(b.indices, np.int32)
+        for b in dataset.batches("train", batch, pad_last=True, with_arrays=False)
+    ]
+    idx = jnp.asarray(
+        np.stack([index_rows[i % len(index_rows)] for i in range(s_steps)])
+    )
+    mask = jnp.ones((s_steps, batch), jnp.float32)
+
+    from stmgcn_tpu.train import gather_window_batch
+
+    x0, _ = gather_window_batch(series, targets, offsets, idx[0], horizon)
+    params0, opt0 = fns.init(jax.random.key(0), jnp.asarray(supports), x0)
+    sup = jnp.asarray(supports)
+
+    # bit-parity: both compiled programs advanced from identical state
+    # (copies — the superstep donates its carry)
+    def run(step_fn, n=3):
+        p = jax.tree.map(jnp.copy, params0)
+        o = jax.tree.map(jnp.copy, opt0)
+        out = None
+        for _ in range(n):
+            out = step_fn(p, o, sup, series, targets, offsets, idx, mask)
+            p, o = out[0], out[1]
+        return jax.device_get(p)
+
+    p_off = run(lambda *a: plain.train_superstep(*a))
+    p_on = run(lambda *a: instr.train_superstep(*a))
+    parity = all(
+        np.array_equal(a, b, equal_nan=True)
+        for a, b in zip(jax.tree.leaves(p_off), jax.tree.leaves(p_on))
+    )
+
+    def timed(step_fn):
+        state = {
+            "p": jax.tree.map(jnp.copy, params0),
+            "o": jax.tree.map(jnp.copy, opt0),
+        }
+
+        def step():
+            out = step_fn(
+                state["p"], state["o"], sup, series, targets, offsets, idx, mask
+            )
+            state["p"], state["o"] = out[0], out[1]
+            return out[2]
+
+        return time_chained(step, iters=10, warmup=2)
+
+    t_off = timed(lambda *a: plain.train_superstep(*a))
+    t_on = timed(lambda *a: instr.train_superstep(*a))
+    return {
+        "parity": parity,
+        "every_k": 1,
+        "s_steps": s_steps,
+        "superstep_ms_off": round(t_off * 1e3, 3),
+        "superstep_ms_on": round(t_on * 1e3, 3),
+        "overhead_pct": round(100.0 * (t_on - t_off) / t_off, 2),
+    }
+
+
 def _data_residency() -> dict:
     """The canonical point's data-residency story: window-free resident
     bytes vs materialized windows, and the dataset build time with and
@@ -965,6 +1068,13 @@ def main() -> None:
         record["data_residency"] = _data_residency()
     except Exception as e:  # the residency story must not void the record
         print(f"bench: data_residency failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        # numeric-health contract evidence: every_k=1 instrumentation
+        # overhead + bit-parity at smoke shapes (see _health_rider)
+        record["health"] = _health_rider()
+    except Exception as e:  # the health story must not void the record
+        print(f"bench: health rider failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     if probe_err is not None:
         record["platform"] = "cpu-fallback"

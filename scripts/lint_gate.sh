@@ -10,7 +10,9 @@
 #    "classes": N|null, "typed_edges": N|null, "findings": N|null},
 #    "ruff": {"available": true|false, "exit": N|null},
 #    "obs": {"exit": N, "recompiles_after_warmup": N|null,
-#    "trace_spans": N|null}}
+#    "trace_spans": N|null},
+#    "health": {"exit": N, "nonfinite": N|null, "records": N|null,
+#    "findings": N|null}}
 #
 # The "concurrency" section is explicit evidence the static concurrency
 # pass (unguarded-attr / lock-order-cycle / condvar-discipline /
@@ -67,8 +69,12 @@ if command -v ruff >/dev/null 2>&1; then
 fi
 
 # Traced smoke run: tiny resident-superstep training with the span
-# tracer + jax.monitoring listener armed; after warmup (first epoch)
-# every compile is a runtime recompile and fails the gate.
+# tracer + jax.monitoring listener armed AND numeric-health telemetry
+# on at every_k=1; after warmup (first epoch) every compile is a
+# runtime recompile and fails the gate, and any nonfinite grad/loss
+# count the health layer saw during the smoke train fails it too. The
+# health-overhead config contract (HealthConfig.violations() per
+# preset) rides the same interpreter.
 obs_json=$(JAX_PLATFORMS=cpu "$PY" - <<'EOF' 2>>/dev/stderr
 import json
 import os
@@ -80,8 +86,11 @@ from stmgcn_tpu.obs import trace as obs_trace
 obs_trace.configure()
 jaxmon.install()
 
+from stmgcn_tpu.analysis.health_check import check_health_overhead
 from stmgcn_tpu.config import preset
 from stmgcn_tpu.experiment import build_trainer
+from stmgcn_tpu.obs.health import load_health
+from stmgcn_tpu.obs.registry import REGISTRY
 
 with tempfile.TemporaryDirectory(prefix="stmgcn_gate_") as tmp:
     cfg = preset("smoke")
@@ -92,17 +101,27 @@ with tempfile.TemporaryDirectory(prefix="stmgcn_gate_") as tmp:
     cfg.train.data_placement = "resident"
     cfg.train.steps_per_superstep = 2
     cfg.train.out_dir = tmp
+    cfg.health.enabled = True
+    cfg.health.out = os.path.join(tmp, "health.jsonl")
     trainer = build_trainer(cfg, verbose=False)
     trainer.train()
     trainer.flush_checkpoints()
     n_spans = obs_trace.active_tracer().export_jsonl(
         os.path.join(tmp, "trace.jsonl")
     )
+    _, health_records = load_health(cfg.health.out)
 snap = jaxmon.snapshot()
+nonfinite = int(
+    REGISTRY.counter("train.health.nonfinite_grads").value
+    + REGISTRY.counter("train.health.nonfinite_loss").value
+)
 print(json.dumps({
     "recompiles_after_warmup": snap["recompiles_after_warmup"],
     "compilations": snap["compilations"],
     "trace_spans": n_spans,
+    "health_nonfinite": nonfinite,
+    "health_records": len(health_records),
+    "health_findings": len(check_health_overhead()),
 }))
 EOF
 )
@@ -145,6 +164,12 @@ ok = ok and (conc.get("classes") or 0) > 0
 if ruff_available:
     ok = ok and ruff_exit == 0
 ok = ok and obs_exit == 0 and recompiles == 0
+# numeric health: the smoke train must have produced records with ZERO
+# nonfinite grad/loss counts, and every preset's health config must
+# pass the health-overhead contract
+ok = ok and obs.get("health_nonfinite") == 0
+ok = ok and (obs.get("health_records") or 0) > 0
+ok = ok and obs.get("health_findings") == 0
 print(json.dumps({
     "gate": "PASS" if ok else "FAIL",
     "lint": {
@@ -164,6 +189,12 @@ print(json.dumps({
         "exit": obs_exit,
         "recompiles_after_warmup": recompiles,
         "trace_spans": obs.get("trace_spans"),
+    },
+    "health": {
+        "exit": obs_exit,
+        "nonfinite": obs.get("health_nonfinite"),
+        "records": obs.get("health_records"),
+        "findings": obs.get("health_findings"),
     },
 }))
 sys.exit(0 if ok else 1)

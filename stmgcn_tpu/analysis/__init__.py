@@ -44,7 +44,9 @@ by tier-1 (``tests/test_analysis.py``):
   resident footprint, :mod:`.fleet_check`), serving bucket-ladder
   math for every preset (strictly increasing, covers max_batch, pad
   waste bounded), observability budget math for every preset (span-ring
-  and histogram-reservoir bounds, :mod:`.obs_check`), and static Pallas
+  and histogram-reservoir bounds, :mod:`.obs_check`), numeric-health
+  config math for every preset (drift-without-baseline, sketch and
+  reservoir budgets, cadence, :mod:`.health_check`), and static Pallas
   kernel checks (:mod:`.pallas_check`):
   grid/BlockSpec divisibility plus a calibrated VMEM-footprint estimate
   for every ``pl.pallas_call`` site in :mod:`stmgcn_tpu.ops.pallas_lstm`,
@@ -58,6 +60,7 @@ Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 from stmgcn_tpu.analysis.collective_check import check_collective_contracts
 from stmgcn_tpu.analysis.concurrency_check import check_concurrency
 from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
+from stmgcn_tpu.analysis.health_check import check_health_overhead
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
 from stmgcn_tpu.analysis.obs_check import check_obs_overhead
@@ -80,6 +83,7 @@ __all__ = [
     "check_collective_contracts",
     "check_concurrency",
     "check_fleet_shape_classes",
+    "check_health_overhead",
     "check_obs_overhead",
     "check_pallas_kernels",
     "check_partition_specs",

@@ -115,6 +115,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # lint must never queue on (or wake) an accelerator
         from stmgcn_tpu.analysis.collective_check import check_collective_contracts
         from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
+        from stmgcn_tpu.analysis.health_check import check_health_overhead
         from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
         from stmgcn_tpu.analysis.obs_check import check_obs_overhead
         from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
@@ -134,6 +135,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_serving_buckets())
         findings.extend(check_serving_slo())
         findings.extend(check_obs_overhead())
+        findings.extend(check_health_overhead())
         # static Pallas checks ride the contract section: deriving the
         # kernel's real block sizes imports ops.pallas_lstm (jax), which
         # --no-contracts' no-JAX promise must not do

@@ -1,0 +1,55 @@
+"""Pass 2i: health-overhead contracts — numeric-health config math.
+
+The health layer carries the same "never become the thing you measure"
+obligation as tracing (:mod:`.obs_check`): a preset whose drift monitor
+has no baseline to compare against silently gauges nothing, a moment
+sketch or reservoir sized past ``config.OBS_RESERVOIR_BUDGET`` regresses
+a long-lived process, and a non-positive cadence makes the sampling
+arithmetic in the trainer undefined. The per-config arithmetic is
+``HealthConfig.violations()``; this pass evaluates it per preset. Pure
+config math — no JAX, no trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_health_overhead"]
+
+
+def check_health_overhead(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+) -> List[Finding]:
+    """Validate every preset's numeric-health knobs.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset. One finding per violation string.
+    """
+    from stmgcn_tpu.config import PRESETS
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="health-overhead",
+                path=f"<contract:health:{name}>",
+                line=0,
+                message=message,
+                severity=RULES["health-overhead"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        health = getattr(cfg, "health", None)
+        if health is None:
+            continue
+        for violation in health.violations():
+            emit(name, f"{name}: {violation}")
+    return findings

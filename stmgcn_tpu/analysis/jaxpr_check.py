@@ -48,7 +48,7 @@ __all__ = [
 #: the count by seq_len), not single-op drift. Keep this a single-line
 #: literal: ``stmgcn lint --rebaseline`` rewrites it in place from the
 #: measured counts (:func:`rebaseline`).
-PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_fleet_superstep": 970, "serve_fleet_bucket": 270, "train_step_checked": 3290}
+PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_series_superstep_health": 1310, "train_fleet_superstep": 970, "serve_fleet_bucket": 270, "train_step_checked": 3290}
 
 
 def _sub_jaxprs(params: dict):
@@ -166,6 +166,10 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     wfns = make_series_superstep_fns(
         model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon
     )
+    hfns = make_series_superstep_fns(
+        model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon,
+        health=True,
+    )
     ffns = make_fleet_superstep_fns(
         model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon
     )
@@ -222,6 +226,13 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
         # the resident series on device (gather_window_batch) before the
         # same shared raw train step
         "train_series_superstep": jax.make_jaxpr(wfns.train_superstep)(
+            params, opt_state, sup, series, targets, offsets, idx_block, mask_block
+        ),
+        # the health-instrumented window-free superstep (health=True):
+        # same math plus on-device grad/update statistics as extra scan
+        # outputs — a checked program of its own so the "bit-identical
+        # when on" variant cannot rot unnoticed
+        "train_series_superstep_health": jax.make_jaxpr(hfns.train_superstep)(
             params, opt_state, sup, series, targets, offsets, idx_block, mask_block
         ),
         # the per-class fleet superstep: scanned steps select the city's

@@ -178,6 +178,15 @@ _ALL_RULES = [
         "long-lived process",
     ),
     Rule(
+        "health-overhead",
+        "error",
+        "a preset's numeric-health knobs are self-defeating (drift "
+        "comparison without a training-time baseline, sketch/reservoir "
+        "sizes outside the documented OBS_RESERVOIR_BUDGET, or a "
+        "non-positive sampling cadence) — HealthConfig.violations() "
+        "config math, detectable before any step runs",
+    ),
+    Rule(
         "pallas-blockspec",
         "error",
         "a pl.pallas_call BlockSpec/grid disagrees with its operand "

@@ -180,6 +180,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "device superstep / checkpoint) plus JAX compile "
                         "telemetry and write the schema-versioned JSONL "
                         "timeline to PATH; inspect with `stmgcn obs PATH`")
+    p.add_argument("--health-out", type=str, default=None, metavar="PATH",
+                   help="enable numeric-health telemetry and write the "
+                        "schema-versioned health.jsonl (loss / grad norm / "
+                        "update ratio / nonfinite counts / per-group and "
+                        "per-city attribution) to PATH; inspect with "
+                        "`stmgcn health PATH`")
+    p.add_argument("--health-every-k", type=_positive_int, default=None,
+                   metavar="K",
+                   help="health sampling cadence: instrument every K-th "
+                        "step (per-step path) or superstep block (fused "
+                        "paths); implies health telemetry on (default 1)")
     p.add_argument("--resume", nargs="?", const="strict", default=None,
                    choices=("strict", "auto"),
                    help="resume before training from the newest *verified* "
@@ -295,6 +306,12 @@ def config_from_args(args) -> "ExperimentConfig":
         cfg.mesh.region_strategy = args.region_strategy
     if args.halo is not None:
         cfg.mesh.halo = args.halo
+    if args.health_out is not None or args.health_every_k is not None:
+        cfg.health.enabled = True
+        if args.health_out is not None:
+            cfg.health.out = args.health_out
+        if args.health_every_k is not None:
+            cfg.health.every_k = args.health_every_k
     return cfg
 
 
@@ -318,6 +335,11 @@ def main(argv=None) -> int:
         from stmgcn_tpu.obs.cli import main as obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "health":
+        # numeric-health report: stdlib+numpy, no JAX backend initialization
+        from stmgcn_tpu.obs.cli import health_main
+
+        return health_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     if args.print_config:

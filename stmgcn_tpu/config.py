@@ -22,6 +22,7 @@ from stmgcn_tpu.ops.graph import SupportConfig, support_count
 __all__ = [
     "DataConfig",
     "ExperimentConfig",
+    "HealthConfig",
     "MeshConfig",
     "ModelConfig",
     "OBS_RESERVOIR_BUDGET",
@@ -483,6 +484,89 @@ class ObsConfig:
 
 
 @dataclasses.dataclass
+class HealthConfig:
+    """Numeric health & drift telemetry knobs (:mod:`stmgcn_tpu.obs`).
+
+    Off by default — the disabled path must compile the *same* step
+    program as a build without the feature (the jaxpr budget for
+    ``train_series_superstep`` pins this). ``violations()`` is the
+    pure-config contract behind the ``health-overhead`` lint rule,
+    mirroring :meth:`ObsConfig.violations`: a cadence below 1 silently
+    disables the telemetry it claims to provide, sketches past the
+    ``OBS_*`` budget family are unbounded per-city memory at fleet
+    scale, and drift gauges without a baseline can never fire.
+    """
+
+    #: compute on-device training health stats (grad norms, update
+    #: ratio, nonfinite counts) and stream them to ``health.jsonl``
+    enabled: bool = False
+    #: compute/download health stats every k-th superstep (1 = every
+    #: superstep); must be >= 1
+    every_k: int = 1
+    #: per-channel histogram bins of the drift sketches (input moments
+    #: + prediction distribution); bounded by OBS_RESERVOIR_BUDGET
+    sketch_size: int = 64
+    #: bounded sample window retained per drift sketch for debugging;
+    #: bounded by OBS_RESERVOIR_BUDGET
+    reservoir: int = 256
+    #: compare live serving sketches against the training-time baseline
+    #: and publish per-city z-score/PSI gauges
+    drift: bool = False
+    #: capture a training-time moment baseline into checkpoint meta
+    #: (required for drift gauges — they have nothing to compare
+    #: against without it)
+    baseline: bool = True
+    #: health.jsonl destination; None = ``<out_dir>/health.jsonl``
+    out: Optional[str] = None
+
+    def violations(self) -> list:
+        """Every way this config breaks the documented overhead budget
+        (empty list = valid; the ``health-overhead`` rule). Sketch and
+        reservoir bounds always apply — the serving drift sketches
+        exist whether or not training health is on; cadence only
+        matters once the training side is enabled.
+        """
+        v = []
+        if self.sketch_size < 1:
+            v.append(
+                f"sketch_size must be >= 1, got {self.sketch_size} — "
+                "drift histograms need at least one bin"
+            )
+        elif self.sketch_size > OBS_RESERVOIR_BUDGET:
+            v.append(
+                f"sketch_size {self.sketch_size} exceeds the documented "
+                f"budget {OBS_RESERVOIR_BUDGET} — finer drift bins past "
+                "the budget buy no sensitivity, only per-city memory"
+            )
+        if self.reservoir < 0:
+            v.append(
+                f"reservoir must be >= 0, got {self.reservoir} — "
+                "0 disables sample retention, negatives mean nothing"
+            )
+        elif self.reservoir > OBS_RESERVOIR_BUDGET:
+            v.append(
+                f"reservoir {self.reservoir} exceeds the documented "
+                f"budget {OBS_RESERVOIR_BUDGET} — retained drift "
+                "samples past the budget are unbounded per-city memory"
+            )
+        if self.drift and not self.baseline:
+            v.append(
+                "drift gauges are enabled but baseline capture is off — "
+                "without a training-time baseline in checkpoint meta the "
+                "z-score/PSI gauges can never fire"
+            )
+        if not self.enabled:
+            return v
+        if self.every_k < 1:
+            v.append(
+                f"every_k must be >= 1 when health is enabled, got "
+                f"{self.every_k} — a non-positive cadence silently "
+                "disables the telemetry this config claims to provide"
+            )
+        return v
+
+
+@dataclasses.dataclass
 class ExperimentConfig:
     name: str = "default"
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
@@ -491,6 +575,7 @@ class ExperimentConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -505,6 +590,7 @@ class ExperimentConfig:
             mesh=MeshConfig(**d.get("mesh", {})),
             serving=ServingConfig(**d.get("serving", {})),
             obs=ObsConfig(**d.get("obs", {})),
+            health=HealthConfig(**d.get("health", {})),
         )
 
 

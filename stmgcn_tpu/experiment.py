@@ -469,6 +469,11 @@ def build_trainer(
         divergence_patience=t.divergence_patience,
         divergence_lr_cut=t.divergence_lr_cut,
         fault_plan=fault_plan,
+        health=cfg.health.enabled,
+        health_every_k=cfg.health.every_k,
+        health_out=cfg.health.out,
+        health_baseline=cfg.health.baseline,
+        health_sketch_size=cfg.health.sketch_size,
         shuffle=t.shuffle,
         seed=t.seed,
         out_dir=t.out_dir,

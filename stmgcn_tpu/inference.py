@@ -42,6 +42,7 @@ class Forecaster:
         config: ExperimentConfig,
         derived: dict,
         normalizers=None,
+        health_baseline: Optional[dict] = None,
     ):
         self.model = model
         self.params = params
@@ -52,6 +53,10 @@ class Forecaster:
         self.normalizers = normalizers
         self.config = config
         self.derived = derived  # {"input_dim": C, "n_nodes": N | [N_city...]}
+        #: training-time drift baseline from checkpoint meta (None when
+        #: the run trained without health baseline capture) — what the
+        #: serving engines' DriftMonitor compares live traffic against
+        self.health_baseline = health_baseline
         self._apply = jax.jit(model.apply)
 
     @classmethod
@@ -74,7 +79,8 @@ class Forecaster:
             ]
         model = build_model(cfg, meta["derived"]["input_dim"])
         params = jax.tree.map(jnp.asarray, params)
-        return cls(model, params, normalizer, cfg, meta["derived"], normalizers)
+        return cls(model, params, normalizer, cfg, meta["derived"], normalizers,
+                   health_baseline=meta.get("health_baseline"))
 
     @property
     def seq_len(self) -> int:

@@ -1,10 +1,11 @@
 """Unified runtime observability: span tracing, the process-wide
-metrics registry, and JAX compile/transfer telemetry.
+metrics registry, JAX compile/transfer telemetry, and the numeric
+health/drift layer (``health.jsonl`` + serving distribution drift).
 
-Stdlib-only at import time (jax loads lazily inside
-:func:`jaxmon.install` and :meth:`trace.Span.fence`), off by default,
-and free when off: hot loops hoist :func:`active_tracer` and skip every
-obs call when it returns ``None``.
+jax-free at import time (it loads lazily inside :func:`jaxmon.install`
+and :meth:`trace.Span.fence`; :mod:`.drift` needs only numpy), off by
+default, and free when off: hot loops hoist :func:`active_tracer` and
+skip every obs call when it returns ``None``.
 """
 
 from .registry import (
@@ -30,26 +31,55 @@ from .jaxmon import (
     mark_warmup_complete,
     record_upload,
 )
-from . import jaxmon, report
+from .health import (
+    HEALTH_SCHEMA_VERSION,
+    HealthWriter,
+    load_health,
+    publish_train_health,
+    render_health_table,
+    summarize_health,
+)
+from .drift import (
+    DRIFT_SCHEMA_VERSION,
+    DriftMonitor,
+    MomentSketch,
+    baseline_from_samples,
+    drift_metrics,
+    psi,
+)
+from . import drift, health, jaxmon, report
 
 __all__ = [
     "Counter",
+    "DRIFT_SCHEMA_VERSION",
+    "DriftMonitor",
     "Gauge",
+    "HEALTH_SCHEMA_VERSION",
+    "HealthWriter",
     "MetricsRegistry",
+    "MomentSketch",
     "REGISTRY",
     "Reservoir",
     "SCHEMA_VERSION",
     "Span",
     "Tracer",
     "active_tracer",
+    "baseline_from_samples",
     "configure",
+    "drift",
+    "drift_metrics",
     "enabled",
+    "health",
     "install",
     "installed",
     "jaxmon",
+    "load_health",
     "mark_warmup_complete",
+    "psi",
+    "publish_train_health",
     "record_upload",
     "registry",
-    "report",
+    "render_health_table",
     "span",
+    "summarize_health",
 ]

@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["load_trace", "summarize", "render_table"]
+__all__ = ["chrome_trace", "load_trace", "summarize", "render_table"]
 
 
 def load_trace(path: str) -> Tuple[Optional[dict], List[dict]]:
@@ -79,6 +79,68 @@ def summarize(spans: List[dict]) -> dict:
     coverage = round(sum(p["self_ms"] for p in rows) / wall_ms, 4)
     return {"wall_ms": round(wall_ms, 3), "coverage": coverage,
             "phases": rows}
+
+
+def chrome_trace(meta: Optional[dict], spans: List[dict]) -> dict:
+    """Convert a span list to the Chrome trace-event JSON format
+    (chrome://tracing / Perfetto "load legacy trace").
+
+    Spans become complete ("X") duration events with microsecond
+    timestamps. The trace format nests same-track events by time
+    containment, so tracks must hold non-overlapping roots: root spans
+    (``parent == 0``) are assigned greedily to the first track whose
+    previous root already ended, concurrent roots (overlapping time
+    ranges — e.g. the checkpoint writer thread under a superstep) open
+    new tracks, and children inherit their root's track so each nested
+    family renders as one flame.
+    """
+    by_id = {s.get("id", 0): s for s in spans}
+
+    def root_of(s: dict) -> int:
+        seen = set()
+        while s.get("parent", 0) and s["parent"] in by_id:
+            if s.get("id") in seen:  # defensive: cyclic parent links
+                break
+            seen.add(s.get("id"))
+            s = by_id[s["parent"]]
+        return s.get("id", 0)
+
+    roots = sorted(
+        (s for s in spans if not (s.get("parent", 0) in by_id)),
+        key=lambda s: s["ts"],
+    )
+    track_end: List[float] = []  # per-track latest root end time
+    root_tid: Dict[int, int] = {}
+    for r in roots:
+        for tid, end in enumerate(track_end):
+            if r["ts"] >= end:
+                break
+        else:
+            tid = len(track_end)
+            track_end.append(0.0)
+        track_end[tid] = r["ts"] + r["dur_ms"]
+        root_tid[r.get("id", 0)] = tid
+
+    events = []
+    for s in spans:
+        ev = {
+            "name": s["name"],
+            "ph": "X",
+            "pid": 0,
+            "tid": root_tid.get(root_of(s), 0),
+            "ts": round(s["ts"] * 1e3, 1),       # chrome wants microseconds
+            "dur": round(s["dur_ms"] * 1e3, 1),
+        }
+        if s.get("attrs"):
+            ev["args"] = s["attrs"]
+        events.append(ev)
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if meta:
+        out["otherData"] = {
+            k: meta[k] for k in ("schema_version", "capacity", "dropped")
+            if k in meta
+        }
+    return out
 
 
 def render_table(summary: dict, meta: Optional[dict] = None) -> str:

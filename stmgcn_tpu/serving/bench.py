@@ -23,8 +23,11 @@ and a per-city bit-parity spot check. ``--soak`` adds the overload leg
 (:func:`run_soak_leg`, ``record["soak"]``): open-loop arrivals above the
 host's calibrated capacity against an SLO-configured engine — typed shed
 counts, admitted-request percentiles vs the derived SLO target, a
-mid-soak atomic param hot-swap with per-generation bit parity, and a
-``contended`` marker from :mod:`stmgcn_tpu.utils.hostload`. NOT imported
+mid-soak atomic param hot-swap with per-generation bit parity, a
+distribution-drift rider (shifted soak stream vs a calibration-fitted
+baseline, generation-labeled gauges reset by the swap —
+``record["soak"]["drift"]``), and a ``contended`` marker from
+:mod:`stmgcn_tpu.utils.hostload`. NOT imported
 by ``stmgcn_tpu.serving.__init__`` — the throwaway-checkpoint trainer
 pulls the full stack, and the serving package must stay lean for
 ``stmgcn_tpu.export``.
@@ -486,6 +489,13 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
        perturbed checkpoint under full load; responses carry their
        generation, and a bit-parity spot-check pins each generation's
        outputs to ``Forecaster.predict`` with the matching params.
+    4. **distribution drift** — a :class:`~stmgcn_tpu.obs.drift
+       .DriftMonitor` rides on the engine with a baseline fitted to the
+       calibration traffic, while the soak stream is deliberately
+       shifted (``x1.6 + 10``): the generation-labeled drift gauges must
+       move under the shifted load (``record["drift"]["pre_swap"]``) and
+       the mid-soak swap must reset them atomically (``post_swap`` shows
+       the bumped generation and a fresh, smaller sample count).
 
     The record marks ``contended`` via :func:`stmgcn_tpu.utils.hostload
     .is_contended` — on a noisy host, judge ``slo_met`` accordingly.
@@ -495,6 +505,7 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
     from stmgcn_tpu.config import ServingConfig
     from stmgcn_tpu.inference import Forecaster
     from stmgcn_tpu.obs import jaxmon
+    from stmgcn_tpu.obs.drift import baseline_from_samples
     from stmgcn_tpu.obs.registry import REGISTRY
     from stmgcn_tpu.serving.admission import DeadlineExceeded, Overloaded
     from stmgcn_tpu.serving.engine import ServingEngine
@@ -517,12 +528,29 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
     with ServingEngine.from_forecaster(fc, supports, config=probe_cfg) as pr:
         for _ in range(3):
             pr.predict_direct(h_req)
-        t0 = time.perf_counter()
+        out_cal = pr.predict_direct(h_req)  # in-dist predictions for the
+        t0 = time.perf_counter()            # drift baseline below
         n_probe = 10
         for _ in range(n_probe):
             pr.predict_direct(h_req)
         per_dispatch_ms = (time.perf_counter() - t0) * 1e3 / n_probe
     capacity_rps = top / (per_dispatch_ms / 1e3)
+
+    # drift baseline fitted to the calibration-distribution traffic; the
+    # soak stream below is shifted so the monitor has something to catch
+    drift_bins = 32
+    drift_baseline = {
+        "schema_version": 1,
+        "bins": drift_bins,
+        "input": {"0": baseline_from_samples(
+            h_req.reshape(-1, input_dim), bins=drift_bins
+        )},
+        "prediction": {"0": baseline_from_samples(
+            np.asarray(out_cal, np.float32).reshape(-1, input_dim),
+            bins=drift_bins,
+        )},
+    }
+    h_soak = (h_req * 1.6 + 10.0).astype(np.float32)
 
     # SLO derived from the measured floor: tolerate a queue ~5 dispatches
     # deep (the queue bound sheds Overloaded first at 4), then shed on
@@ -560,6 +588,10 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
     try:
         base = fc.predict(supports, h_req)
         parity_gen0 = bool(np.array_equal(base, engine.predict_direct(h_req)))
+        # arm drift AFTER the parity probe so the sketches hold only the
+        # (shifted) soak stream; the swap below must reset them
+        engine.enable_drift(drift_baseline, city=0)
+        drift_pre: List[dict] = []
 
         new_params = jax.tree.map(lambda a: a * 1.001, fc.params)
         fc_new = Forecaster(
@@ -586,7 +618,7 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
                     my_behind += 1  # fired late but still fired: open loop
                 t0 = time.perf_counter()
                 try:
-                    _, gen = engine.predict(h_req, with_generation=True)
+                    _, gen = engine.predict(h_soak, with_generation=True)
                     my_admitted.append((time.perf_counter() - t0) * 1e3)
                     my_gens[gen] = my_gens.get(gen, 0) + 1
                 except Overloaded:
@@ -611,6 +643,9 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
 
         def mid_soak_swap():
             try:
+                # the drift sketches as the shifted stream left them,
+                # captured the instant before the swap resets them
+                drift_pre.append(engine.drift_snapshot())
                 engine.swap_params(new_params)
                 swap_done.set()
             except Exception as e:  # a failed swap must land in the record,
@@ -631,6 +666,10 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
         recompiles_soak = (
             int(jaxmon.freeze_recompiles()) if jaxmon.installed() else None
         )
+        # post-swap drift state BEFORE the parity probe below feeds the
+        # gen-1 sketches in-dist rows: must show the bumped generation
+        # and only post-swap soak traffic
+        drift_post = engine.drift_snapshot()
         # generation-1 parity after the dust settles: the engine now
         # serves the swapped params and must match a Forecaster built
         # from them bit-exactly
@@ -696,6 +735,12 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
             },
             "parity_gen0": parity_gen0,
             "parity_gen1": parity_gen1,
+        },
+        "drift": {
+            "bins": drift_bins,
+            "stream_shift": "x1.6 + 10",
+            "pre_swap": drift_pre[0] if drift_pre else None,
+            "post_swap": drift_post,
         },
         "host_load": host_load,
         "contended": is_contended(host_load),

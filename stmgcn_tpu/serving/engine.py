@@ -195,7 +195,9 @@ class CheckpointWatcher:
             self.rejected += 1
             REGISTRY.counter("serving.ckpt_rejected").inc()
             return False
-        eng.swap_params(params)
+        eng.swap_params(
+            params, health_baseline=_meta.get("health_baseline")
+        )
         self.swaps += 1
         self.last_path = path
         self._applied_mtime = mtime
@@ -278,6 +280,10 @@ class ServingEngine:
             self._run_program, self._buckets, config.max_delay_ms, self.stats,
             admission=self.admission, fault_plan=self._fault_plan,
         )
+        #: live distribution-drift monitor (obs/drift.DriftMonitor); None
+        #: until :meth:`enable_drift` attaches one
+        self.drift = None
+        self._drift_city = "0"
         self._closed = False
 
     # -- construction ---------------------------------------------------
@@ -368,6 +374,10 @@ class ServingEngine:
         # verified loads restore against the live checkpoint's pytree
         engine._prepare_params = lambda p: to_dense_serving(fc.model, p, m)[1]
         engine._params_template = fc.params
+        hb = getattr(fc, "health_baseline", None)
+        hcfg = getattr(fc.config, "health", None)
+        if hb is not None and hcfg is not None and hcfg.drift:
+            engine.enable_drift(hb, city=city if city is not None else 0)
         return engine
 
     @classmethod
@@ -407,6 +417,27 @@ class ServingEngine:
         ex._engine = engine  # route ex.predict through the bucket ladder
         return engine
 
+    # -- drift ----------------------------------------------------------
+
+    def enable_drift(self, baseline: dict, *, city: int = 0,
+                     registry=REGISTRY):
+        """Attach a :class:`stmgcn_tpu.obs.drift.DriftMonitor` comparing
+        live traffic against a training-time ``health_baseline`` blob
+        (checkpoint meta). Auto-attached by ``from_forecaster`` when the
+        checkpoint carries a baseline and its config enables
+        ``health.drift``. Returns the monitor."""
+        from stmgcn_tpu.obs.drift import DriftMonitor
+
+        self._drift_city = str(city)
+        self.drift = DriftMonitor(
+            baseline, registry=registry, generation=self.generation
+        )
+        return self.drift
+
+    def drift_snapshot(self) -> Optional[dict]:
+        """JSON-able live drift state, or None without a monitor."""
+        return None if self.drift is None else self.drift.snapshot()
+
     # -- hot swap --------------------------------------------------------
 
     @property
@@ -414,7 +445,7 @@ class ServingEngine:
         """Monotonic param-generation counter (0 = construction params)."""
         return self._current[0]
 
-    def swap_params(self, params) -> int:
+    def swap_params(self, params, *, health_baseline=None) -> int:
         """Atomically replace the serving parameters; returns the new
         generation.
 
@@ -425,6 +456,11 @@ class ServingEngine:
         swap. In-flight dispatches finish on the generation they read at
         entry; every later dispatch sees the new one. No AOT rebuild:
         the compiled programs take params as an argument.
+
+        An attached drift monitor resets atomically with the swap — its
+        live sketches drop so gauges never mix traffic across param
+        generations; ``health_baseline`` (the new checkpoint's blob, when
+        the watcher has one) replaces the comparison baseline too.
         """
         if self._prepare_params is None:
             raise RuntimeError(
@@ -436,6 +472,8 @@ class ServingEngine:
         gen, cur_dev = self._current
         _check_swap_structure(cur_dev, new_dev)
         self._current = (gen + 1, new_dev)
+        if self.drift is not None:
+            self.drift.reset(gen + 1, baseline=health_baseline)
         REGISTRY.counter("serving.swaps").inc()
         REGISTRY.gauge("serving.generation").set(gen + 1)
         return gen + 1
@@ -491,7 +529,14 @@ class ServingEngine:
         out = np.asarray(
             self._programs[bucket](params_dev, pad_to_bucket(batch, bucket))
         )
-        return (norm.inverse(out) if norm is not None else out), gen
+        out = norm.inverse(out) if norm is not None else out
+        if self.drift is not None:
+            # real rows only: batch is payload-sized (pre-pad) and the
+            # padded prediction rows are bucket filler, not traffic
+            n_rows = payload.shape[0]
+            self.drift.observe_input(self._drift_city, batch[:n_rows])
+            self.drift.observe_prediction(self._drift_city, out[:n_rows])
+        return out, gen
 
     def _call_batched(self, history: np.ndarray, normalized: bool):
         """Micro-batched path; returns ``(out, generation)`` with every

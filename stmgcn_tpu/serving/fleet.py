@@ -157,6 +157,9 @@ class FleetServingEngine:
             )
             for ci in range(len(self._groups))
         }
+        #: live distribution-drift monitor shared by every class (per-city
+        #: sketches inside); None until :meth:`enable_drift` attaches one
+        self.drift = None
         self._closed = False
 
     # -- construction ---------------------------------------------------
@@ -251,7 +254,30 @@ class FleetServingEngine:
                      params_dev=params_dev, fault_plan=fault_plan)
         engine._prepare_params = lambda p: to_dense_serving(fc.model, p, m)[1]
         engine._params_template = fc.params
+        hb = getattr(fc, "health_baseline", None)
+        hcfg = getattr(fc.config, "health", None)
+        if hb is not None and hcfg is not None and hcfg.drift:
+            engine.enable_drift(hb)
         return engine
+
+    # -- drift ----------------------------------------------------------
+
+    def enable_drift(self, baseline: dict, *, registry=REGISTRY):
+        """Attach a :class:`stmgcn_tpu.obs.drift.DriftMonitor` comparing
+        live per-city traffic against the training-time baseline blob.
+        Auto-attached by ``from_forecaster`` when the checkpoint carries
+        one and its config enables ``health.drift``. Returns the
+        monitor."""
+        from stmgcn_tpu.obs.drift import DriftMonitor
+
+        self.drift = DriftMonitor(
+            baseline, registry=registry, generation=self.generation
+        )
+        return self.drift
+
+    def drift_snapshot(self) -> Optional[dict]:
+        """JSON-able live drift state, or None without a monitor."""
+        return None if self.drift is None else self.drift.snapshot()
 
     # -- hot swap --------------------------------------------------------
 
@@ -260,15 +286,18 @@ class FleetServingEngine:
         """Monotonic param-generation counter (0 = construction params)."""
         return self._current[0]
 
-    def swap_params(self, params) -> int:
+    def swap_params(self, params, *, health_baseline=None) -> int:
         """Atomically re-point every shape class at new parameters;
         returns the new generation (same contract as
         :meth:`ServingEngine.swap_params` — raw checkpoint pytree in,
-        one reference swap, no AOT rebuild)."""
+        one reference swap, no AOT rebuild, attached drift monitor reset
+        atomically with the swap)."""
         new_dev = jax.tree.map(jnp.asarray, self._prepare_params(params))
         gen, cur_dev = self._current
         _check_swap_structure(cur_dev, new_dev)
         self._current = (gen + 1, new_dev)
+        if self.drift is not None:
+            self.drift.reset(gen + 1, baseline=health_baseline)
         REGISTRY.counter("serving.swaps").inc()
         REGISTRY.gauge("serving.generation").set(gen + 1)
         return gen + 1
@@ -343,6 +372,15 @@ class FleetServingEngine:
                 nc = self._city_n[c]
                 out[ofs:ofs + n, ..., :nc, :] = norm.inverse(
                     out[ofs:ofs + n, ..., :nc, :]
+                )
+        if self.drift is not None:
+            # per segment, real-node slice only: padded node columns are
+            # class filler, not any city's traffic
+            for ofs, n, (c, _) in segments:
+                nc = self._city_n[c]
+                self.drift.observe_input(c, batch[ofs:ofs + n, :, :nc, :])
+                self.drift.observe_prediction(
+                    c, out[ofs:ofs + n, ..., :nc, :]
                 )
         if len({c for _, _, (c, _) in segments}) > 1:
             self.cross_city_dispatches += 1

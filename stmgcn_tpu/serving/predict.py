@@ -18,10 +18,17 @@ import numpy as np
 __all__ = ["serve_predict"]
 
 
-def serve_predict(call, normalizer, expected, history, normalized: bool) -> np.ndarray:
+def serve_predict(call, normalizer, expected, history, normalized: bool,
+                  *, monitor=None, city: int = 0) -> np.ndarray:
     """Shared raw-units serving flow: validate → normalize → call →
     denormalize. ``expected`` is ``(seq_len, n_nodes, input_dim)``;
-    ``call`` maps a normalized ``(B, T, N, C)`` array to predictions."""
+    ``call`` maps a normalized ``(B, T, N, C)`` array to predictions.
+
+    ``monitor`` (a :class:`stmgcn_tpu.obs.drift.DriftMonitor`) observes
+    at the two distribution boundaries: the normalized inputs the model
+    actually sees, and the denormalized predictions it serves — the
+    values never change, only their moments are recorded.
+    """
     history = np.asarray(history, dtype=np.float32)
     if history.ndim != 4 or history.shape[1:] != tuple(expected):
         raise ValueError(
@@ -30,7 +37,11 @@ def serve_predict(call, normalizer, expected, history, normalized: bool) -> np.n
         )
     if not normalized and normalizer is not None:
         history = normalizer.transform(history)
+    if monitor is not None:
+        monitor.observe_input(city, history)
     pred = np.asarray(call(history))
     if normalizer is not None:
         pred = normalizer.inverse(pred)
+    if monitor is not None:
+        monitor.observe_prediction(city, pred)
     return pred

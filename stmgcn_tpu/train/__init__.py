@@ -22,6 +22,7 @@ from stmgcn_tpu.train.step import (
     StepFns,
     SuperstepFns,
     gather_window_batch,
+    health_group_names,
     make_fleet_superstep_fns,
     make_optimizer,
     make_series_superstep_fns,
@@ -44,6 +45,7 @@ __all__ = [
     "SuperstepFns",
     "Trainer",
     "gather_window_batch",
+    "health_group_names",
     "load_checkpoint",
     "load_latest_verified",
     "make_fleet_superstep_fns",

@@ -32,6 +32,7 @@ __all__ = [
     "StepFns",
     "SuperstepFns",
     "gather_window_batch",
+    "health_group_names",
     "make_checked_raw_train_step",
     "make_fleet_superstep_fns",
     "make_optimizer",
@@ -220,6 +221,49 @@ def _error_set(checks: str):
     }[checks]
 
 
+def health_group_names(tree) -> tuple:
+    """Static layer-group names of a params/grads pytree: the sorted
+    top-level module names under flax's ``"params"`` collection (or the
+    top-level keys of a bare dict). This is the host-side key for the
+    ``(G,)`` per-group norm vector the health scan ys carry."""
+    try:
+        inner = tree["params"] if "params" in tree else tree
+    except TypeError:
+        return ()
+    try:
+        return tuple(sorted(inner.keys()))
+    except AttributeError:
+        return ()
+
+
+def _health_stats(params, grads, updates, loss_val):
+    """On-device numeric health of one optimizer step.
+
+    Pure readout of values the step already computed (grads/updates/
+    pre-update params) — no extra dispatches; the superstep carries
+    these as extra scan ys downloaded with the losses. ``update_ratio``
+    is ‖Δparam‖/‖param‖, the classic learning-dynamics gauge (~1e-3
+    healthy; ~1 means the optimizer is overwriting the model).
+    """
+    names = health_group_names(grads)
+    inner = grads["params"] if names and "params" in grads else grads
+    group = (
+        jnp.stack([optax.global_norm(inner[k]) for k in names])
+        if names else jnp.zeros((0,), jnp.float32)
+    )
+    nonfinite = sum(
+        jnp.sum(~jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)
+    )
+    return {
+        "grad_norm": optax.global_norm(grads),
+        "update_ratio": optax.global_norm(updates)
+        / jnp.maximum(optax.global_norm(params), 1e-12),
+        "nonfinite_grads": jnp.asarray(nonfinite, jnp.int32),
+        "nonfinite_loss": (~jnp.isfinite(loss_val)).astype(jnp.int32),
+        "group_norms": group,
+    }
+
+
 def _raw_step_bodies(model, optimizer, loss: str):
     """The unjitted init/train/eval bodies shared by :func:`make_step_fns`
     and :func:`make_superstep_fns`.
@@ -228,6 +272,12 @@ def _raw_step_bodies(model, optimizer, loss: str):
     structural rather than coincidental: the scan body runs the *same*
     Python function the per-step path jits, so the two paths can only
     diverge if XLA itself breaks determinism.
+
+    ``train_step_full`` is the same body returning the grads/updates it
+    already computed — the health variants read their statistics off
+    those, and ``train_step`` dropping them adds no primitives
+    (``jax.make_jaxpr`` performs no DCE, so the plain program's jaxpr is
+    unchanged — the ``train_series_superstep`` budget pins this).
     """
     if loss not in LOSSES:
         raise ValueError(f"loss must be one of {LOSSES}, got {loss!r}")
@@ -249,19 +299,25 @@ def _raw_step_bodies(model, optimizer, loss: str):
         params = model.init(rng, supports, x)
         return params, optimizer.init(params)
 
-    def train_step(params, opt_state, supports, x, y, mask, n_real=None):
+    def train_step_full(params, opt_state, supports, x, y, mask, n_real=None):
         (loss_val, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, supports, x, y, mask, n_real
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, opt_state, loss_val, grads, updates, params
+
+    def train_step(params, opt_state, supports, x, y, mask, n_real=None):
+        params, opt_state, loss_val, _, _, _ = train_step_full(
+            params, opt_state, supports, x, y, mask, n_real
+        )
         return params, opt_state, loss_val
 
     def eval_step(params, supports, x, y, mask, n_real=None):
         loss_val, pred = loss_fn(params, supports, x, y, mask, n_real)
         return loss_val, pred
 
-    return init, train_step, eval_step
+    return init, train_step, eval_step, train_step_full
 
 
 def make_step_fns(
@@ -269,6 +325,7 @@ def make_step_fns(
     optimizer: optax.GradientTransformation,
     loss: str = "mse",
     checks: str | None = None,
+    health: bool = False,
 ) -> StepFns:
     """Build jitted init/train/eval steps for a flax model.
 
@@ -289,11 +346,27 @@ def make_step_fns(
     the op's location. Debug tool: error flags are fetched per step, so
     it costs a device sync per call — unlike ``jax_debug_nans`` it works
     under jit *with* donation and on TPU without recompiling per op.
+
+    ``health=True`` builds the numeric-health variant: ``train_step``
+    returns ``(params, opt_state, loss, stats)`` where ``stats`` is the
+    :func:`_health_stats` dict read off the grads/updates the step
+    already computed. The params/opt-state/loss math is the *same*
+    shared body, so results are bit-identical to the plain step.
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
-    init, train_step, eval_step = _raw_step_bodies(model, optimizer, loss)
+    init, train_step, eval_step, train_step_full = _raw_step_bodies(
+        model, optimizer, loss
+    )
+    if health:
+        def train_step(params, opt_state, supports, x, y, mask, n_real=None):
+            params, opt_state, loss_val, grads, updates, prev = train_step_full(
+                params, opt_state, supports, x, y, mask, n_real
+            )
+            return params, opt_state, loss_val, _health_stats(
+                prev, grads, updates, loss_val
+            )
 
     # init is jitted too: eager flax init dispatches hundreds of tiny ops,
     # which is pathologically slow on remote-tunneled TPU backends.
@@ -346,7 +419,7 @@ def make_checked_raw_train_step(
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
     from jax.experimental import checkify
 
-    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+    _, train_step, _, _ = _raw_step_bodies(model, optimizer, loss)
     return checkify.checkify(train_step, errors=_error_set(checks))
 
 
@@ -355,6 +428,7 @@ def make_superstep_fns(
     optimizer: optax.GradientTransformation,
     loss: str = "mse",
     checks: str | None = None,
+    health: bool = False,
 ) -> SuperstepFns:
     """Fuse S train steps into one jitted ``lax.scan`` over microbatches.
 
@@ -381,11 +455,20 @@ def make_superstep_fns(
     ``checks`` wraps the whole superstep in ``jax.experimental.checkify``
     (same sets as :func:`make_step_fns`); the error surfaces after the
     S-step program, not at the individual failing step.
+
+    ``health=True`` builds the health-instrumented program variant:
+    each scan step additionally carries its :func:`_health_stats` dict
+    as extra scan ys, so ``train_superstep`` returns ``(params,
+    opt_state, losses, stats)`` with ``(S,)``/``(S, G)`` stat arrays —
+    downloaded with the losses in the same host readback, no extra
+    dispatches. The params/loss math is the same shared body, so the
+    health program is bit-identical to the plain one; health *off*
+    builds exactly today's program (the jaxpr budget pins this).
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
-    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+    _, train_step, _, train_step_full = _raw_step_bodies(model, optimizer, loss)
 
     def train_superstep(params, opt_state, supports, x_all, y_all, idx_block, mask_block):
         def body(carry, step_inputs):
@@ -393,15 +476,24 @@ def make_superstep_fns(
             idx, mask = step_inputs
             x = jnp.take(x_all, idx, axis=0)
             y = jnp.take(y_all, idx, axis=0)
+            if health:
+                params, opt_state, loss_val, grads, updates, prev = (
+                    train_step_full(params, opt_state, supports, x, y, mask)
+                )
+                stats = _health_stats(prev, grads, updates, loss_val)
+                return (params, opt_state), (loss_val, stats)
             params, opt_state, loss_val = train_step(
                 params, opt_state, supports, x, y, mask
             )
             return (params, opt_state), loss_val
 
-        (params, opt_state), losses = jax.lax.scan(
+        (params, opt_state), ys = jax.lax.scan(
             body, (params, opt_state), (idx_block, mask_block)
         )
-        return params, opt_state, losses
+        if health:
+            losses, stats = ys
+            return params, opt_state, losses, stats
+        return params, opt_state, ys
 
     if checks is None:
         return SuperstepFns(
@@ -429,6 +521,7 @@ def make_series_superstep_fns(
     loss: str = "mse",
     horizon: int = 1,
     checks: str | None = None,
+    health: bool = False,
 ) -> SeriesSuperstepFns:
     """The superstep of :func:`make_superstep_fns` over window-free data.
 
@@ -441,12 +534,14 @@ def make_series_superstep_fns(
     shared raw train step, and the losses come back as ordered scan ys,
     so results stay bit-identical to the materialized superstep and to
     the per-step loop. ``horizon`` is static (it shapes ``y``); ``checks``
-    wraps the whole program in checkify as in :func:`make_superstep_fns`.
+    wraps the whole program in checkify as in :func:`make_superstep_fns`;
+    ``health=True`` adds the per-step :func:`_health_stats` scan ys
+    (same semantics and bit-identity guarantees as there).
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
-    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+    _, train_step, _, train_step_full = _raw_step_bodies(model, optimizer, loss)
 
     def train_superstep(
         params, opt_state, supports, series, targets, offsets, idx_block, mask_block
@@ -455,15 +550,24 @@ def make_series_superstep_fns(
             params, opt_state = carry
             idx, mask = step_inputs
             x, y = gather_window_batch(series, targets, offsets, idx, horizon)
+            if health:
+                params, opt_state, loss_val, grads, updates, prev = (
+                    train_step_full(params, opt_state, supports, x, y, mask)
+                )
+                stats = _health_stats(prev, grads, updates, loss_val)
+                return (params, opt_state), (loss_val, stats)
             params, opt_state, loss_val = train_step(
                 params, opt_state, supports, x, y, mask
             )
             return (params, opt_state), loss_val
 
-        (params, opt_state), losses = jax.lax.scan(
+        (params, opt_state), ys = jax.lax.scan(
             body, (params, opt_state), (idx_block, mask_block)
         )
-        return params, opt_state, losses
+        if health:
+            losses, stats = ys
+            return params, opt_state, losses, stats
+        return params, opt_state, ys
 
     if checks is None:
         return SeriesSuperstepFns(
@@ -496,6 +600,7 @@ def make_fleet_superstep_fns(
     loss: str = "mse",
     horizon: int = 1,
     checks: str | None = None,
+    health: bool = False,
 ) -> FleetSuperstepFns:
     """The window-free superstep of :func:`make_series_superstep_fns`
     generalized to one fleet *shape class* of cities.
@@ -514,11 +619,18 @@ def make_fleet_superstep_fns(
     exactly what the materialized per-city oracle computes
     (``tests/test_fleet.py``). Padded nodes carry zero supports, a
     traced-masked gate pool, and zero ``(B, N_c)`` loss-mask columns.
+
+    ``health=True`` adds the per-step :func:`_health_stats` scan ys
+    plus fleet-only per-city loss attribution: the scan body already
+    knows each step's member slot, so ``stats["city_loss"]`` is the
+    ``(S, n_members)`` one-hot scatter of each step's loss into its
+    slot — summing it over both axes reproduces the summed fleet loss
+    exactly, and per-slot columns attribute it city by city.
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
-    _, train_step, _ = _raw_step_bodies(model, optimizer, loss)
+    _, train_step, _, train_step_full = _raw_step_bodies(model, optimizer, loss)
 
     def train_superstep(
         params, opt_state, supports_stack, series, targets, offsets,
@@ -529,15 +641,31 @@ def make_fleet_superstep_fns(
             idx, mask, slot, n_real = step_inputs
             supports = jnp.take(supports_stack, slot, axis=0)
             x, y = gather_window_batch(series, targets, offsets, idx, horizon)
+            if health:
+                params, opt_state, loss_val, grads, updates, prev = (
+                    train_step_full(
+                        params, opt_state, supports, x, y, mask, n_real
+                    )
+                )
+                stats = _health_stats(prev, grads, updates, loss_val)
+                n_members = supports_stack.shape[0]
+                stats["city_loss"] = (
+                    jax.nn.one_hot(slot, n_members, dtype=jnp.float32)
+                    * loss_val
+                )
+                return (params, opt_state), (loss_val, stats)
             params, opt_state, loss_val = train_step(
                 params, opt_state, supports, x, y, mask, n_real
             )
             return (params, opt_state), loss_val
 
-        (params, opt_state), losses = jax.lax.scan(
+        (params, opt_state), ys = jax.lax.scan(
             body, (params, opt_state), (idx_block, mask_block, slot_block, n_real_block)
         )
-        return params, opt_state, losses
+        if health:
+            losses, stats = ys
+            return params, opt_state, losses, stats
+        return params, opt_state, ys
 
     if checks is None:
         return FleetSuperstepFns(

@@ -68,6 +68,7 @@ import numpy as np
 from stmgcn_tpu.data.pipeline import DemandDataset
 from stmgcn_tpu.obs import jaxmon
 from stmgcn_tpu.obs import trace as obs_trace
+from stmgcn_tpu.obs.health import HealthWriter, publish_train_health
 from stmgcn_tpu.obs.registry import REGISTRY
 from stmgcn_tpu.resilience.faults import FaultPlan, Preempted
 from stmgcn_tpu.resilience.guard import DivergenceGuard
@@ -82,6 +83,7 @@ from stmgcn_tpu.utils.profiling import fence
 from stmgcn_tpu.train.step import (
     StepFns,
     gather_window_batch,
+    health_group_names,
     make_fleet_superstep_fns,
     make_optimizer,
     make_series_superstep_fns,
@@ -212,6 +214,11 @@ class Trainer:
         divergence_patience: int = 3,
         divergence_lr_cut: Optional[float] = None,
         fault_plan: Optional[FaultPlan] = None,
+        health: bool = False,
+        health_every_k: int = 1,
+        health_out: Optional[str] = None,
+        health_baseline: bool = True,
+        health_sketch_size: int = 64,
         placement=None,
         extra_meta: Optional[dict] = None,
         verbose: bool = True,
@@ -280,6 +287,30 @@ class Trainer:
         #: deterministic fault injection (tests); the empty default plan
         #: makes every hook a no-op, so this *is* the production code path
         self.fault_plan = fault_plan if fault_plan is not None else FaultPlan()
+        if health_every_k < 1:
+            raise ValueError(
+                f"health_every_k must be >= 1, got {health_every_k}"
+            )
+        if health_sketch_size < 1:
+            raise ValueError(
+                f"health_sketch_size must be >= 1, got {health_sketch_size}"
+            )
+        #: numeric health telemetry: on a cadence (every K dispatch units —
+        #: steps on the per-step path, blocks on the fused paths) the
+        #: health-instrumented step/superstep variants run instead of the
+        #: plain ones, returning on-device stats (grad norms, update
+        #: ratio, nonfinite counts, fleet per-city loss attribution) that
+        #: one device_get downloads into health.jsonl + the registry.
+        #: Params stay bit-identical; off, the plain programs are the
+        #: byte-same jaxprs as before (see train/step.py).
+        self.health = bool(health)
+        self.health_every_k = health_every_k
+        self.health_sketch_size = health_sketch_size
+        self._health_out = health_out
+        self._health_baseline_on = bool(health_baseline)
+        self._health_counter = 0
+        self._health_writer: Optional[HealthWriter] = None
+        self._health_baseline_cache: Optional[dict] = None
         self._guard = (
             DivergenceGuard(
                 action=divergence_action,
@@ -425,23 +456,31 @@ class Trainer:
         self._optimizer_factory = _optimizer_factory
         self._optimizer = _optimizer_factory()
 
-        def _fresh_fns(mdl):
-            return make_step_fns(mdl, self._optimizer, loss, checks=checks)
+        def _fresh_fns(mdl, health: bool = False):
+            return make_step_fns(
+                mdl, self._optimizer, loss, checks=checks, health=health
+            )
 
         self._make_fns = _fresh_fns
         self.step_fns = _fresh_fns(model)
+        # health-instrumented twins, built lazily on the first due health
+        # step/block; separate compilations so health-off epochs never pay
+        self._health_step_fns = None
         # built lazily on first superstep epoch — most trainers never need
         # it; the window-free variant gathers each scan step's microbatch
         # from the resident series instead of materialized window arrays
-        self._make_superstep_fns = lambda: (
+        self._make_superstep_fns = lambda health=False: (
             make_series_superstep_fns(
                 model, self._optimizer, loss,
-                horizon=self._horizon, checks=checks,
+                horizon=self._horizon, checks=checks, health=health,
             )
             if self._window_free
-            else make_superstep_fns(model, self._optimizer, loss, checks=checks)
+            else make_superstep_fns(
+                model, self._optimizer, loss, checks=checks, health=health
+            )
         )
         self._superstep_fns = None
+        self._health_superstep_fns = None
         # Per-city gate pooling under per-city node padding: cities with
         # padded node rows need their own n_real_nodes (a static module
         # attribute), so their steps close over a clone of the model. jit
@@ -474,8 +513,10 @@ class Trainer:
         self._fleet_targets_cache: dict = {}
         self._fleet_supports_cache: dict = {}
         self._fleet_fns = None
-        self._make_fleet_fns = lambda: make_fleet_superstep_fns(
-            model, self._optimizer, loss, horizon=self._horizon, checks=checks
+        self._health_fleet_fns = None
+        self._make_fleet_fns = lambda health=False: make_fleet_superstep_fns(
+            model, self._optimizer, loss, horizon=self._horizon,
+            checks=checks, health=health,
         )
         if fleet_max_classes < 1:
             raise ValueError(f"fleet_max_classes must be >= 1, got {fleet_max_classes}")
@@ -807,6 +848,10 @@ class Trainer:
             ]
         elif self.dataset.normalizer is not None:
             meta["normalizer"] = self.dataset.normalizer.to_dict()
+        if self.health and self._health_baseline_on:
+            hb = self._health_baseline_blob()
+            if hb is not None:
+                meta["health_baseline"] = hb
         meta.update(self.extra_meta)
         return meta
 
@@ -877,6 +922,134 @@ class Trainer:
                 self.model.clone(n_real_nodes=self._city_n_real[city])
             )
         return self._city_fns[city]
+
+    def _health_fns(self, city: int):
+        """Health-instrumented twin of :meth:`_fns` (same routing, same
+        update arithmetic — the extra outputs are already-computed
+        intermediates, so params stay bit-identical)."""
+        key = ("health", city)
+        info = self._fleet_cities.get(city)
+        if info is not None:
+            if key not in self._city_fns:
+                if self._health_step_fns is None:
+                    self._health_step_fns = self._make_fns(
+                        self.model, health=True
+                    )
+                base = self._health_step_fns
+                nr = jnp.int32(info.n_real)
+                self._city_fns[key] = StepFns(
+                    init=base.init,
+                    train_step=lambda p, o, s, x, y, m, _b=base, _nr=nr: (
+                        _b.train_step(p, o, s, x, y, m, _nr)
+                    ),
+                    eval_step=lambda p, s, x, y, m, _b=base, _nr=nr: (
+                        _b.eval_step(p, s, x, y, m, _nr)
+                    ),
+                )
+            return self._city_fns[key]
+        if self._city_n_real is None or self._city_n_real[city] is None:
+            if self._health_step_fns is None:
+                self._health_step_fns = self._make_fns(self.model, health=True)
+            return self._health_step_fns
+        if key not in self._city_fns:
+            self._city_fns[key] = self._make_fns(
+                self.model.clone(n_real_nodes=self._city_n_real[city]),
+                health=True,
+            )
+        return self._city_fns[key]
+
+    def _health_due(self) -> bool:
+        """Cadence gate, ticked once per dispatch unit (a step on the
+        per-step path, a fused block on the superstep/fleet paths)."""
+        if not self.health:
+            return False
+        due = self._health_counter % self.health_every_k == 0
+        self._health_counter += 1
+        return due
+
+    def _health_out_path(self) -> str:
+        return self._health_out or os.path.join(self.out_dir, "health.jsonl")
+
+    def _health_emit(self, stats, losses, *, cities=None) -> None:
+        """Download one health dispatch's device stats (a single
+        ``device_get`` covering stats + losses) and fan out: registry
+        gauges/counters on every host, ``health.jsonl`` on the lead."""
+        stats_h, losses_h = jax.device_get((stats, losses))
+        losses_h = np.atleast_1d(np.asarray(losses_h, np.float64))
+
+        def _last(key):
+            return float(np.atleast_1d(np.asarray(stats_h[key]))[-1])
+
+        groups = health_group_names(self.params)
+        gmat = np.atleast_2d(np.asarray(stats_h["group_norms"]))
+        rec = {
+            "kind": "train",
+            "epoch": self.epoch,
+            "step": self.global_step,
+            "steps": int(losses_h.shape[0]),
+            "loss": float(losses_h[-1]),
+            "grad_norm": _last("grad_norm"),
+            "update_ratio": _last("update_ratio"),
+            "nonfinite_grads": int(np.sum(stats_h["nonfinite_grads"])),
+            "nonfinite_loss": int(np.sum(stats_h["nonfinite_loss"])),
+            "group_norms": {
+                g: float(v) for g, v in zip(groups, gmat[-1])
+            },
+        }
+        if cities is not None and "city_loss" in stats_h:
+            csum = np.atleast_2d(
+                np.asarray(stats_h["city_loss"])).sum(axis=0)
+            rec["city_loss"] = {
+                str(cities[slot]): float(v)
+                for slot, v in enumerate(csum)
+                if slot < len(cities)
+            }
+        publish_train_health(rec, REGISTRY)
+        if self.is_lead:
+            if self._health_writer is None:
+                self._health_writer = HealthWriter(
+                    self._health_out_path(),
+                    {"every_k": self.health_every_k,
+                     "groups": list(groups)},
+                )
+            self._health_writer.write(rec)
+
+    def _health_baseline_blob(self) -> Optional[dict]:
+        """Training-time drift baseline for checkpoint meta.
+
+        Per city and phase: ``input`` summarizes the *normalized* series
+        (what the model sees at the serving normalize boundary),
+        ``prediction`` the denormalized values (the scale served
+        predictions land on). Stride-subsampled to bound the two-pass
+        cost; cached — the data never changes within a run.
+        """
+        if self._health_baseline_cache is not None:
+            return self._health_baseline_cache
+        from stmgcn_tpu.obs.drift import baseline_from_samples
+
+        ds = self.dataset
+        if not hasattr(ds, "series"):
+            return None
+        hetero = getattr(ds, "heterogeneous", False)
+        n_cities = getattr(ds, "n_cities", 1)
+        bins = self.health_sketch_size
+        blob: dict = {"schema_version": 1, "bins": bins,
+                      "input": {}, "prediction": {}}
+        for c in range(n_cities):
+            series = np.asarray(ds.series(c), dtype=np.float64)
+            flat = series.reshape(-1, series.shape[-1])
+            stride = max(1, flat.shape[0] // 65536)
+            flat = flat[::stride]
+            denorm = (
+                ds.denormalize(flat, city=c) if hetero
+                else ds.denormalize(flat)
+            )
+            blob["input"][str(c)] = baseline_from_samples(flat, bins=bins)
+            blob["prediction"][str(c)] = baseline_from_samples(
+                np.asarray(denorm, dtype=np.float64), bins=bins
+            )
+        self._health_baseline_cache = blob
+        return blob
 
     def _placed_batches(
         self,
@@ -1276,10 +1449,20 @@ class Trainer:
                 jax.tree.map(jnp.copy, self.params),
                 jax.tree.map(jnp.copy, self.opt_state),
             )
-        fns = self._fns(batch.city)
-        self.params, self.opt_state, loss = fns.train_step(
-            self.params, self.opt_state, self._supports_for(batch), x, y, mask
-        )
+        health_due = self._health_due()
+        hstats = None
+        if health_due:
+            fns = self._health_fns(batch.city)
+            self.params, self.opt_state, loss, hstats = fns.train_step(
+                self.params, self.opt_state, self._supports_for(batch),
+                x, y, mask,
+            )
+        else:
+            fns = self._fns(batch.city)
+            self.params, self.opt_state, loss = fns.train_step(
+                self.params, self.opt_state, self._supports_for(batch),
+                x, y, mask,
+            )
         if not retry:
             self._batch_in_epoch += 1
         if guard is not None and not np.isfinite(float(loss)):
@@ -1299,6 +1482,8 @@ class Trainer:
         self.global_step += 1
         self._epoch_losses.append(loss)
         self._epoch_counts.append(batch.n_real)
+        if hstats is not None:
+            self._health_emit(hstats, loss)
 
     def _after_train_batch(self) -> None:
         """Step-cadence latest write + SIGTERM safe point, after every
@@ -1338,6 +1523,9 @@ class Trainer:
         self.step_fns = self._make_fns(self.model)
         self._superstep_fns = None
         self._fleet_fns = None
+        self._health_step_fns = None
+        self._health_superstep_fns = None
+        self._health_fleet_fns = None
         self._city_fns.clear()
 
     def _pack_blocks(self, batches, mode: str):
@@ -1393,16 +1581,18 @@ class Trainer:
             targets = self._resident_targets(mode, 0)
             offsets = self._offsets_device()
 
-            def dispatch(idx_d, mask_d):
-                return self._superstep_fns.train_superstep(
+            def dispatch(idx_d, mask_d, fns=None):
+                fns = fns if fns is not None else self._superstep_fns
+                return fns.train_superstep(
                     self.params, self.opt_state, sup, series, targets,
                     offsets, idx_d, mask_d,
                 )
         else:
             x_all, y_all = self._resident_arrays(mode, 0)
 
-            def dispatch(idx_d, mask_d):
-                return self._superstep_fns.train_superstep(
+            def dispatch(idx_d, mask_d, fns=None):
+                fns = fns if fns is not None else self._superstep_fns
+                return fns.train_superstep(
                     self.params, self.opt_state, sup, x_all, y_all,
                     idx_d, mask_d,
                 )
@@ -1473,7 +1663,17 @@ class Trainer:
                     jax.tree.map(jnp.copy, self.opt_state),
                 )
             t_d0 = 0.0 if trc is None else time.perf_counter()
-            self.params, self.opt_state, loss_vec = dispatch(idx_d, mask_d)
+            hstats = None
+            if self._health_due():
+                if self._health_superstep_fns is None:
+                    self._health_superstep_fns = self._make_superstep_fns(
+                        health=True
+                    )
+                self.params, self.opt_state, loss_vec, hstats = dispatch(
+                    idx_d, mask_d, self._health_superstep_fns
+                )
+            else:
+                self.params, self.opt_state, loss_vec = dispatch(idx_d, mask_d)
             # superstep i is dispatched; upload block i+1 under its compute
             placed = placer(blocks[i + 1]) if i + 1 < len(blocks) else None
             if trc is not None:
@@ -1503,6 +1703,8 @@ class Trainer:
             self.global_step += S
             self._epoch_losses.append(loss_vec)  # (S,) — stays on device
             self._epoch_counts.extend(n_reals)
+            if hstats is not None:
+                self._health_emit(hstats, loss_vec)
             self._after_train_batch()
         for batch in remainder:
             x, y, mask = self._place_batch(batch, mode)
@@ -1634,12 +1836,25 @@ class Trainer:
                         jax.tree.map(jnp.copy, self.opt_state),
                     )
                 t_d0 = 0.0 if trc is None else time.perf_counter()
-                self.params, self.opt_state, loss_vec = (
-                    self._fleet_fns.train_superstep(
-                        self.params, self.opt_state, sup_stack, series,
-                        targets, offsets, idx_d, mask_d, slot_d, nr_d,
+                hstats = None
+                if self._health_due():
+                    if self._health_fleet_fns is None:
+                        self._health_fleet_fns = self._make_fleet_fns(
+                            health=True
+                        )
+                    self.params, self.opt_state, loss_vec, hstats = (
+                        self._health_fleet_fns.train_superstep(
+                            self.params, self.opt_state, sup_stack, series,
+                            targets, offsets, idx_d, mask_d, slot_d, nr_d,
+                        )
                     )
-                )
+                else:
+                    self.params, self.opt_state, loss_vec = (
+                        self._fleet_fns.train_superstep(
+                            self.params, self.opt_state, sup_stack, series,
+                            targets, offsets, idx_d, mask_d, slot_d, nr_d,
+                        )
+                    )
                 # block i is dispatched; upload i+1 under its compute
                 placed = placer(blocks[i + 1]) if i + 1 < len(blocks) else None
                 if trc is not None:
@@ -1666,6 +1881,11 @@ class Trainer:
                 self.global_step += S
                 self._epoch_losses.append(loss_vec)  # (S,) — stays on device
                 self._epoch_counts.extend(n_reals)
+                if hstats is not None:
+                    self._health_emit(
+                        hstats, loss_vec,
+                        cities=self._fleet_plan.classes[info.cls].cities,
+                    )
                 self._after_train_batch()
             for batch in remainder:
                 per_step(batch)
@@ -1710,6 +1930,8 @@ class Trainer:
         finally:
             if in_main:
                 signal.signal(signal.SIGTERM, prev_handler)
+            if self._health_writer is not None:
+                self._health_writer.flush()
         self.flush_checkpoints()
         self._event("train_end", f"Training ends at: {time.ctime()}")
         return history

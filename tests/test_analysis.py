@@ -890,6 +890,96 @@ class TestObsOverheadRule:
         assert check_obs_overhead([("none", object())]) == []
 
 
+class TestHealthOverheadRule:
+    """Pass 2i: the health-overhead config contract — numeric-health
+    knobs that make the telemetry layer a regression (or a no-op) of
+    its own. Boundaries pinned exactly like obs-overhead: the budget
+    itself is clean, one past it is flagged; cadence only gates once
+    the training side is enabled."""
+
+    @staticmethod
+    def _cfg(**kw):
+        from stmgcn_tpu.config import HealthConfig, preset
+
+        cfg = preset("smoke")
+        cfg.health = HealthConfig(**kw)
+        return cfg
+
+    def test_rule_registered_as_error(self):
+        assert RULES["health-overhead"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_health_overhead
+
+        assert check_health_overhead() == []
+
+    def test_sketch_budget_boundary(self):
+        from stmgcn_tpu.analysis import check_health_overhead
+        from stmgcn_tpu.config import OBS_RESERVOIR_BUDGET
+
+        # sketch bounds apply even with training health OFF — the
+        # serving drift sketches exist in every serving process
+        f = check_health_overhead(
+            [("bad", self._cfg(sketch_size=OBS_RESERVOIR_BUDGET + 1))]
+        )
+        assert f and all(x.rule == "health-overhead" for x in f)
+        assert all(x.severity == "error" for x in f)
+        assert any("budget" in x.message for x in f)
+        assert f[0].path == "<contract:health:bad>"
+        assert check_health_overhead(
+            [("ok", self._cfg(sketch_size=OBS_RESERVOIR_BUDGET))]
+        ) == []
+        f = check_health_overhead([("bad", self._cfg(sketch_size=0))])
+        assert any("at least one bin" in x.message for x in f)
+
+    def test_reservoir_budget_boundary(self):
+        from stmgcn_tpu.analysis import check_health_overhead
+        from stmgcn_tpu.config import OBS_RESERVOIR_BUDGET
+
+        f = check_health_overhead(
+            [("bad", self._cfg(reservoir=OBS_RESERVOIR_BUDGET + 1))]
+        )
+        assert any("budget" in x.message for x in f)
+        assert check_health_overhead(
+            [("ok", self._cfg(reservoir=OBS_RESERVOIR_BUDGET))]
+        ) == []
+        # 0 legitimately disables retention; negatives mean nothing
+        assert check_health_overhead([("ok", self._cfg(reservoir=0))]) == []
+        f = check_health_overhead([("bad", self._cfg(reservoir=-1))])
+        assert any("reservoir" in x.message for x in f)
+
+    def test_drift_without_baseline_flagged(self):
+        from stmgcn_tpu.analysis import check_health_overhead
+
+        f = check_health_overhead(
+            [("bad", self._cfg(drift=True, baseline=False))]
+        )
+        assert any("never fire" in x.message for x in f)
+        assert check_health_overhead(
+            [("ok", self._cfg(drift=True, baseline=True))]
+        ) == []
+
+    def test_cadence_only_checked_when_enabled(self):
+        from stmgcn_tpu.analysis import check_health_overhead
+
+        # disabled: an absurd cadence is dormant config, not a finding
+        assert check_health_overhead(
+            [("off", self._cfg(enabled=False, every_k=0))]
+        ) == []
+        f = check_health_overhead(
+            [("on", self._cfg(enabled=True, every_k=0))]
+        )
+        assert any("every_k" in x.message for x in f)
+        assert check_health_overhead(
+            [("on", self._cfg(enabled=True, every_k=1))]
+        ) == []
+
+    def test_configs_without_health_section_skipped(self):
+        from stmgcn_tpu.analysis import check_health_overhead
+
+        assert check_health_overhead([("none", object())]) == []
+
+
 class TestResidentMemoryRule:
     """Pass 2f: the resident-memory footprint contract (pure config math
     — the same arithmetic as DemandDataset.resident_nbytes/nbytes,
@@ -1981,3 +2071,10 @@ class TestLintGateScript:
         assert payload["obs"]["exit"] == 0
         assert payload["obs"]["recompiles_after_warmup"] == 0
         assert payload["obs"]["trace_spans"] > 0
+        # the numeric-health section: the health-instrumented smoke
+        # train produced records with zero nonfinite counts, and every
+        # preset passed the health-overhead config contract
+        assert payload["health"]["exit"] == 0
+        assert payload["health"]["nonfinite"] == 0
+        assert payload["health"]["records"] > 0
+        assert payload["health"]["findings"] == 0

@@ -1,0 +1,589 @@
+"""Numeric health & drift telemetry: bit-parity, cadence, attribution,
+sketches, checkpoint baselines, serving drift lifecycle.
+
+The health layer's core claim is "free when off, bit-identical when on":
+the health-instrumented step/superstep variants run the SAME shared raw
+train step and only *read* statistics off grads/updates the step already
+computed, so params/opt-state/losses must match the plain path bit for
+bit — exact equality, not allclose — on the per-step, fused-superstep,
+and fleet paths alike. The "free when off" half is a jaxpr pin: the
+plain ``train_series_superstep`` primitive count must not move when the
+health variant exists alongside it (``jax.make_jaxpr`` does no DCE, so
+any leak of health math into the plain program shows up as a count
+change).
+
+The serving side is numpy-only (drift sketches ride ``serve_predict``,
+which never traces): Welford moments vs the two-pass numpy oracle,
+drift z/PSI firing on a shifted stream and staying silent for cities
+without a baseline, the ``health_baseline`` blob round-tripping through
+checkpoint meta, and the DriftMonitor resetting atomically with
+``swap_params`` so gauges never mix param generations.
+"""
+
+import json
+import math
+
+import jax
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import ServingConfig, preset
+from stmgcn_tpu.data import (
+    DemandDataset,
+    HeteroCityDataset,
+    MinMaxNormalizer,
+    WindowSpec,
+    synthetic_dataset,
+)
+from stmgcn_tpu.experiment import build_model
+from stmgcn_tpu.inference import Forecaster
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.obs.drift import (
+    DriftMonitor,
+    MomentSketch,
+    baseline_from_samples,
+    drift_metrics,
+    psi,
+)
+from stmgcn_tpu.obs.health import (
+    HEALTH_SCHEMA_VERSION,
+    HealthWriter,
+    load_health,
+    publish_train_health,
+    render_health_table,
+    summarize_health,
+)
+from stmgcn_tpu.obs.registry import MetricsRegistry
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.serving import ServingEngine
+from stmgcn_tpu.train import CitySupports, Trainer
+from stmgcn_tpu.train.checkpoint import load_checkpoint
+
+BATCH = 8
+CITY_DIMS = ((3, 3), (2, 4), (2, 2))
+
+
+def build(out_dir, *, superstep=1, epochs=2, placement="resident", **kw):
+    data = synthetic_dataset(rows=5, n_timesteps=24 * 7 * 2 + 60, seed=1)
+    dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    sup = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8)
+    return Trainer(model, dataset, sup, n_epochs=epochs, batch_size=BATCH,
+                   steps_per_superstep=superstep, data_placement=placement,
+                   out_dir=str(out_dir), verbose=False, **kw)
+
+
+def build_fleet(out_dir, *, superstep=2, epochs=2, **kw):
+    datas = [
+        synthetic_dataset(rows=r, cols=c, n_timesteps=24 * 7 * 2 + 12 * i,
+                          seed=i + 1)
+        for i, (r, c) in enumerate(CITY_DIMS)
+    ]
+    dataset = HeteroCityDataset(datas, WindowSpec(3, 1, 1, 24))
+    sup = CitySupports(
+        SupportConfig("chebyshev", 2).build_all(d.adjs.values())
+        for d in datas
+    )
+    model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                   horizon=1, lstm_hidden_dim=8, lstm_num_layers=1,
+                   gcn_hidden_dim=8)
+    return Trainer(model, dataset, sup, n_epochs=epochs, batch_size=BATCH,
+                   steps_per_superstep=superstep, out_dir=str(out_dir),
+                   verbose=False, **kw)
+
+
+def same(a, b):
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+def train_and_load(trainer):
+    hist = trainer.train()
+    trainer.flush_checkpoints()
+    return hist
+
+
+def build_smoke_trainer(out_dir, **health_kw):
+    """Experiment-level trainer (checkpoint meta carries config/derived,
+    so Forecaster.from_checkpoint can rebuild the model)."""
+    from stmgcn_tpu.experiment import build_trainer
+
+    cfg = preset("smoke")
+    cfg.data.rows = 5
+    cfg.data.n_timesteps = 24 * 7 * 2 + 60
+    cfg.train.epochs = 1
+    cfg.train.batch_size = BATCH
+    cfg.train.data_placement = "resident"
+    cfg.train.steps_per_superstep = 2
+    cfg.train.out_dir = str(out_dir)
+    for k, v in health_kw.items():
+        setattr(cfg.health, k, v)
+    return build_trainer(cfg, verbose=False), cfg
+
+
+# -- moment sketch vs the numpy oracle ---------------------------------
+
+
+class TestMomentSketch:
+    def test_welford_batched_merge_matches_numpy(self):
+        """Chunked streaming updates reproduce the two-pass mean/std of
+        the concatenation — the property that makes the sketch a valid
+        stand-in for retaining raw samples."""
+        rng = np.random.default_rng(0)
+        chunks = [rng.normal(3.0, 2.0, (n, 3)) for n in (1, 17, 256, 40)]
+        sk = MomentSketch(3, bins=16)
+        for c in chunks:
+            assert sk.update(c) == c.shape[0]
+        allv = np.concatenate(chunks)
+        np.testing.assert_allclose(sk.mean, allv.mean(axis=0), rtol=1e-12)
+        np.testing.assert_allclose(sk.std(), allv.std(axis=0, ddof=1),
+                                   rtol=1e-10)
+        assert sk.n == allv.shape[0]
+        # no norm: histogram counts stay zero, probs degrade to uniform
+        assert sk.counts.sum() == 0
+        np.testing.assert_allclose(sk.probs(), np.full(16, 1 / 16))
+
+    def test_normed_histogram_probs_sum_to_one(self):
+        rng = np.random.default_rng(1)
+        sk = MomentSketch(2, bins=8, norm=(np.zeros(2), np.ones(2)))
+        sk.update(rng.normal(0, 1, (500, 2)))
+        assert sk.counts.sum() == 1000  # pooled over channels
+        np.testing.assert_allclose(sk.probs().sum(), 1.0)
+
+    def test_baseline_blob_schema(self):
+        blob = baseline_from_samples(
+            np.random.default_rng(2).normal(5, 3, (400, 2)), bins=16)
+        assert set(blob) == {"n", "mean", "std", "hist"}
+        assert blob["n"] == 400 and len(blob["mean"]) == 2
+        assert len(blob["hist"]) == 16
+        np.testing.assert_allclose(sum(blob["hist"]), 1.0)
+        json.dumps(blob)  # must be JSON-able as stored in checkpoint meta
+
+    def test_psi_and_drift_metrics(self):
+        base = np.full(8, 1 / 8)
+        assert psi(base, base) == pytest.approx(0.0, abs=1e-12)
+        shifted = np.array([0.5, 0.3, 0.1, 0.1, 0, 0, 0, 0])
+        assert psi(base, shifted) > 0.25
+        # empty sketch: drift is defined as zero, not NaN
+        blob = baseline_from_samples(np.ones((10, 1)), bins=8)
+        assert drift_metrics(blob, MomentSketch(1, bins=8)) == {
+            "n": 0, "z_max": 0.0, "psi": 0.0}
+
+
+class TestDriftMonitor:
+    @staticmethod
+    def _baseline(bins=16, seed=0):
+        rng = np.random.default_rng(seed)
+        return {
+            "schema_version": 1, "bins": bins,
+            "input": {"0": baseline_from_samples(
+                rng.normal(10.0, 2.0, (4000, 1)), bins=bins)},
+        }
+
+    def test_fires_on_shifted_city_silent_on_held_out(self):
+        mon = DriftMonitor(self._baseline())
+        rng = np.random.default_rng(3)
+        # same-distribution traffic: PSI stays under the stable rule of
+        # thumb; shifted traffic blows past both gates
+        mon.observe_input(0, rng.normal(10.0, 2.0, (2000, 1)))
+        calm = mon.snapshot()["cities"]["0"]["input"]
+        assert calm["n"] == 2000 and calm["psi"] < 0.1
+
+        hot = DriftMonitor(self._baseline())
+        hot.observe_input(0, rng.normal(26.0, 2.0, (2000, 1)))
+        # a held-out city with no baseline is silently ignored — nothing
+        # to compare against, and it must NOT pollute the snapshot
+        hot.observe_input(1, rng.normal(99.0, 1.0, (50, 1)))
+        snap = hot.snapshot()
+        m = snap["cities"]["0"]["input"]
+        assert m["z_max"] > 10 and m["psi"] > 0.25
+        assert "1" not in snap["cities"]
+
+    def test_reset_drops_sketches_and_bumps_generation(self):
+        reg = MetricsRegistry()
+        mon = DriftMonitor(self._baseline(), registry=reg)
+        mon.observe_input(0, np.full((100, 1), 30.0))
+        assert mon.snapshot()["cities"]["0"]["input"]["n"] == 100
+        labels = {"city": "0", "phase": "input", "generation": "0"}
+        assert reg.gauge("serving.drift.n", labels).value == 100
+
+        mon.reset(1)
+        snap = mon.snapshot()
+        assert snap["generation"] == 1 and snap["cities"] == {}
+        assert reg.gauge("serving.drift.generation").value == 1
+        # fresh traffic after the reset accumulates under the new label
+        mon.observe_input(0, np.full((7, 1), 10.0))
+        labels_g1 = {"city": "0", "phase": "input", "generation": "1"}
+        assert reg.gauge("serving.drift.n", labels_g1).value == 7
+
+    def test_reset_with_new_baseline_swaps_comparison(self):
+        mon = DriftMonitor(self._baseline())
+        new = {"bins": 8, "input": {"0": baseline_from_samples(
+            np.random.default_rng(4).normal(50.0, 1.0, (1000, 1)), bins=8)}}
+        mon.reset(1, baseline=new)
+        assert mon.bins == 8
+        mon.observe_input(0, np.random.default_rng(5).normal(
+            50.0, 1.0, (500, 1)))
+        assert mon.snapshot()["cities"]["0"]["input"]["psi"] < 0.1
+
+
+# -- health.jsonl writer / report --------------------------------------
+
+
+class TestHealthStream:
+    def test_writer_lazy_open_and_roundtrip(self, tmp_path):
+        path = tmp_path / "health.jsonl"
+        w = HealthWriter(str(path), {"every_k": 2, "groups": ["a"]})
+        assert not path.exists()  # lazy: no record, no file
+        w.write({"kind": "train", "step": 1, "loss": 0.5})
+        w.close()
+        meta, records = load_health(str(path))
+        assert meta["schema_version"] == HEALTH_SCHEMA_VERSION
+        assert meta["every_k"] == 2 and meta["groups"] == ["a"]
+        assert records == [{"schema_version": HEALTH_SCHEMA_VERSION,
+                            "kind": "train", "step": 1, "loss": 0.5}]
+
+    def test_load_rejects_non_object_lines(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text('[1, 2]\n')
+        with pytest.raises(ValueError, match="expected JSON object"):
+            load_health(str(p))
+
+    def test_publish_counters_only_inc_when_nonzero(self):
+        reg = MetricsRegistry()
+        publish_train_health({"loss": 1.0, "grad_norm": 2.0,
+                              "nonfinite_grads": 0,
+                              "group_norms": {"lstm": 0.5}}, reg)
+        assert reg.gauge("train.health.loss").value == 1.0
+        assert reg.gauge("train.health.group_norm",
+                         {"group": "lstm"}).value == 0.5
+        assert reg.counter("train.health.nonfinite_grads").value == 0
+        publish_train_health({"nonfinite_grads": 3, "nonfinite_loss": 1}, reg)
+        assert reg.counter("train.health.nonfinite_grads").value == 3
+        assert reg.counter("train.health.nonfinite_loss").value == 1
+
+    def test_summary_and_table_cover_train_and_drift(self):
+        records = [
+            {"kind": "train", "step": 2, "loss": 0.5, "grad_norm": 1.0,
+             "update_ratio": 1e-3, "nonfinite_grads": 0, "nonfinite_loss": 0,
+             "group_norms": {"lstm": 0.7}, "city_loss": {"0": 0.4}},
+            {"kind": "train", "step": 4, "loss": 0.25, "grad_norm": 2.0,
+             "update_ratio": 2e-3, "nonfinite_grads": 1, "nonfinite_loss": 0,
+             "group_norms": {"lstm": 0.9}, "city_loss": {"0": 0.2}},
+            {"kind": "drift", "city": "0", "phase": "input", "z_max": 12.5,
+             "psi": 0.4, "n": 100, "generation": 1},
+        ]
+        s = summarize_health(records)
+        assert s["records"] == 3
+        assert s["train"]["count"] == 2 and s["train"]["last_step"] == 4
+        assert s["train"]["loss"] == {"last": 0.25, "mean": 0.375, "max": 0.5}
+        assert s["train"]["nonfinite_grads"] == 1
+        assert s["train"]["groups"]["lstm"]["max"] == 0.9
+        assert s["drift"]["worst"]["city"] == "0"
+        assert s["drift"]["worst"]["z_max"] == 12.5
+        text = render_health_table(s, {"schema_version": 1, "every_k": 1})
+        assert "grad_norm[lstm]" in text and "city_loss[0]" in text
+        assert "worst city 0" in text
+        assert render_health_table(summarize_health([])) == \
+            "(no health records)"
+
+
+# -- trainer bit-parity: health on == health off -----------------------
+
+
+class TestTrainerParity:
+    """health=True must not move a single bit of params/opt-state/history
+    on any dispatch path — the stats are a pure readout."""
+
+    def _check(self, on, off):
+        h_on, h_off = train_and_load(on), train_and_load(off)
+        np.testing.assert_array_equal(h_on["train"], h_off["train"])
+        np.testing.assert_array_equal(h_on["validate"], h_off["validate"])
+        same(on.params, off.params)
+        same(jax.tree.leaves(on.opt_state), jax.tree.leaves(off.opt_state))
+
+    def test_per_step_path(self, tmp_path):
+        out = tmp_path / "h.jsonl"
+        on = build(tmp_path / "on", placement="stream",
+                   health=True, health_out=str(out))
+        off = build(tmp_path / "off", placement="stream")
+        self._check(on, off)
+        meta, records = load_health(str(out))
+        assert meta["every_k"] == 1 and len(records) > 0
+        assert all(r["nonfinite_grads"] == 0 and r["nonfinite_loss"] == 0
+                   for r in records)
+        assert set(records[0]["group_norms"]) == set(meta["groups"])
+
+    def test_fused_superstep_path(self, tmp_path):
+        out = tmp_path / "h.jsonl"
+        on = build(tmp_path / "on", superstep=3,
+                   health=True, health_out=str(out))
+        off = build(tmp_path / "off", superstep=3)
+        self._check(on, off)
+        _, records = load_health(str(out))
+        # fused blocks download per-step stats: steps per record > 1
+        assert any(r["steps"] > 1 for r in records)
+        assert all(math.isfinite(r["grad_norm"]) and
+                   math.isfinite(r["update_ratio"]) for r in records)
+
+    def test_fleet_path(self, tmp_path):
+        out = tmp_path / "h.jsonl"
+        on = build_fleet(tmp_path / "on", health=True, health_out=str(out))
+        off = build_fleet(tmp_path / "off")
+        self._check(on, off)
+        _, records = load_health(str(out))
+        fleet_recs = [r for r in records if "city_loss" in r]
+        assert fleet_recs, "fleet blocks must attribute loss per city"
+        cities = {c for r in fleet_recs for c in r["city_loss"]}
+        assert cities <= {"0", "1", "2"} and len(cities) >= 2
+
+
+class TestCadence:
+    def test_every_k_halves_the_stream(self, tmp_path):
+        outs = {}
+        for k in (1, 2):
+            out = tmp_path / f"h{k}.jsonl"
+            tr = build(tmp_path / f"t{k}", placement="stream", epochs=2,
+                       health=True, health_every_k=k, health_out=str(out))
+            train_and_load(tr)
+            outs[k] = load_health(str(out))
+        meta1, recs1 = outs[1]
+        meta2, recs2 = outs[2]
+        assert meta2["every_k"] == 2
+        # the cadence counter ticks once per dispatch unit, firing on
+        # counter % k == 0 — exactly ceil(n/2) of the k=1 stream
+        assert len(recs2) == (len(recs1) + 1) // 2
+        # same data, same seed: the due steps' records agree on the step
+        steps1 = [r["step"] for r in recs1]
+        assert [r["step"] for r in recs2] == steps1[::2]
+
+    def test_every_k_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="health_every_k"):
+            build(tmp_path, health=True, health_every_k=0)
+        with pytest.raises(ValueError, match="health_sketch_size"):
+            build(tmp_path, health=True, health_sketch_size=0)
+
+
+class TestCityLossAttribution:
+    def test_fleet_city_loss_sums_to_step_losses_bit_exact(
+            self, tmp_path, monkeypatch):
+        """The (S, n_members) one-hot scatter row-sums to the scan's loss
+        vector EXACTLY (one-hot rows are exact 0/1 floats), and the
+        emitted dict's total matches the block's summed loss."""
+        captured = []
+        orig = Trainer._health_emit
+
+        def spy(self, stats, losses, *, cities=None):
+            captured.append(
+                (jax.device_get(stats), jax.device_get(losses), cities))
+            return orig(self, stats, losses, cities=cities)
+
+        monkeypatch.setattr(Trainer, "_health_emit", spy)
+        tr = build_fleet(tmp_path, epochs=1, health=True,
+                         health_out=str(tmp_path / "h.jsonl"))
+        train_and_load(tr)
+
+        fleet_calls = [(s, l, c) for s, l, c in captured
+                       if "city_loss" in s]
+        assert fleet_calls
+        for stats, losses, cities in fleet_calls:
+            cl = np.asarray(stats["city_loss"])  # (S, n_members)
+            losses = np.atleast_1d(np.asarray(losses))
+            assert cl.shape[0] == losses.shape[0]
+            np.testing.assert_array_equal(cl.sum(axis=1), losses)
+            # one-hot: each step charges exactly its own slot
+            assert ((cl != 0).sum(axis=1) <= 1).all()
+            assert cities is not None and cl.shape[1] <= len(CITY_DIMS)
+
+        _, records = load_health(str(tmp_path / "h.jsonl"))
+        for r in records:
+            if "city_loss" in r:
+                total = sum(r["city_loss"].values())
+                assert math.isfinite(total) and total >= 0
+
+
+# -- checkpoint baseline round-trip ------------------------------------
+
+
+class TestCheckpointBaseline:
+    def test_baseline_persisted_and_reloaded(self, tmp_path):
+        tr, _ = build_smoke_trainer(tmp_path, enabled=True, sketch_size=16,
+                                    out=str(tmp_path / "h"))
+        train_and_load(tr)
+        meta, _, _ = load_checkpoint(tr.best_path, load_opt_state=False)
+        hb = meta["health_baseline"]
+        assert hb["schema_version"] == 1 and hb["bins"] == 16
+        assert set(hb["input"]) == {"0"} and set(hb["prediction"]) == {"0"}
+        for phase in ("input", "prediction"):
+            blob = hb[phase]["0"]
+            assert len(blob["hist"]) == 16
+            np.testing.assert_allclose(sum(blob["hist"]), 1.0)
+        # the prediction-phase baseline is on the raw demand scale, the
+        # input phase on the normalized scale — they must differ
+        assert hb["input"]["0"]["mean"] != hb["prediction"]["0"]["mean"]
+
+        fc = Forecaster.from_checkpoint(tr.best_path)
+        assert fc.health_baseline == hb
+
+    def test_fleet_baseline_covers_every_city(self, tmp_path):
+        tr = build_fleet(tmp_path, epochs=1, health=True,
+                         health_out=str(tmp_path / "h"))
+        train_and_load(tr)
+        meta, _, _ = load_checkpoint(tr.best_path, load_opt_state=False)
+        hb = meta["health_baseline"]
+        assert set(hb["input"]) == {"0", "1", "2"}
+
+    def test_checkpoint_without_baseline_still_loads(self, tmp_path):
+        # health off entirely, and health on with baseline capture off:
+        # both write meta without the key, and readers must not care
+        off, _ = build_smoke_trainer(tmp_path / "off")
+        train_and_load(off)
+        meta, _, _ = load_checkpoint(off.best_path, load_opt_state=False)
+        assert "health_baseline" not in meta
+        assert Forecaster.from_checkpoint(off.best_path).health_baseline \
+            is None
+
+        nob = build(tmp_path / "nob", epochs=1, health=True,
+                    health_baseline=False, health_out=str(tmp_path / "h"))
+        train_and_load(nob)
+        meta, _, _ = load_checkpoint(nob.best_path, load_opt_state=False)
+        assert "health_baseline" not in meta
+
+
+# -- serving drift lifecycle -------------------------------------------
+
+
+class TestServingDriftLifecycle:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = preset("smoke")
+        cfg.data.rows = 3
+        data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 40, seed=0)
+        ds = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+        supports = np.asarray(
+            SupportConfig(cfg.model.kernel_type, cfg.model.K)
+            .build_all(ds.adjs.values()), np.float32,
+        )[: cfg.model.m_graphs]
+        model = build_model(cfg, ds.n_feats)
+        x = np.zeros((2, cfg.data.seq_len, ds.n_nodes, ds.n_feats),
+                     np.float32)
+        params = model.init(jax.random.key(0), np.asarray(supports), x)
+        norm = MinMaxNormalizer.fit(np.asarray(data.demand))
+        fc = Forecaster(model, params, norm, cfg,
+                        {"input_dim": ds.n_feats, "n_nodes": ds.n_nodes})
+        return fc, supports, ds
+
+    def _hist(self, fc, ds, b, lo=0.0, hi=50.0, seed=1):
+        rng = np.random.default_rng(seed)
+        return rng.uniform(lo, hi, (b, fc.seq_len, ds.n_nodes, ds.n_feats)
+                           ).astype(np.float32)
+
+    def test_observe_swap_reset(self, setup):
+        fc, supports, ds = setup
+        eng = ServingEngine.from_forecaster(
+            fc, supports,
+            config=ServingConfig(buckets=(1, 2, 4), max_batch=4,
+                                 max_delay_ms=5.0))
+        try:
+            assert eng.drift_snapshot() is None  # no monitor yet
+            cal = self._hist(fc, ds, 4)
+            baseline = {
+                "schema_version": 1, "bins": 16,
+                "input": {"0": baseline_from_samples(
+                    fc.normalizer.transform(cal).reshape(-1, ds.n_feats),
+                    bins=16)},
+                "prediction": {"0": baseline_from_samples(
+                    np.asarray(fc.predict(supports, cal)
+                               ).reshape(-1, ds.n_feats), bins=16)},
+            }
+            eng.enable_drift(baseline, city=0)
+
+            # in-distribution traffic observes at BOTH boundaries
+            eng.predict_direct(self._hist(fc, ds, 4, seed=2))
+            snap = eng.drift_snapshot()
+            assert snap["generation"] == 0
+            assert set(snap["cities"]["0"]) == {"input", "prediction"}
+            n0 = snap["cities"]["0"]["input"]["n"]
+            assert n0 > 0
+
+            # shifted traffic moves the gauges on the SAME generation
+            eng.predict_direct(self._hist(fc, ds, 4, lo=300, hi=400, seed=3))
+            hot = eng.drift_snapshot()["cities"]["0"]["input"]
+            assert hot["n"] > n0 and hot["z_max"] > 10
+
+            # hot-swap: generation bumps, live sketches drop atomically
+            gen = eng.swap_params(fc.params)
+            snap = eng.drift_snapshot()
+            assert gen == 1 and snap["generation"] == 1
+            assert snap["cities"] == {}
+
+            # post-swap traffic accumulates fresh under the new generation
+            eng.predict_direct(self._hist(fc, ds, 2, seed=4))
+            snap = eng.drift_snapshot()
+            assert snap["cities"]["0"]["input"]["n"] > 0
+        finally:
+            eng.close()
+
+    def test_swap_with_new_baseline(self, setup):
+        fc, supports, ds = setup
+        eng = ServingEngine.from_forecaster(
+            fc, supports,
+            config=ServingConfig(buckets=(1, 2), max_batch=2,
+                                 max_delay_ms=5.0))
+        try:
+            eng.enable_drift({"bins": 8, "input": {"0": baseline_from_samples(
+                np.ones((10, ds.n_feats)), bins=8)}})
+            new_base = {"bins": 4, "input": {"0": baseline_from_samples(
+                np.zeros((10, ds.n_feats)), bins=4)}}
+            eng.swap_params(fc.params, health_baseline=new_base)
+            assert eng.drift.bins == 4
+        finally:
+            eng.close()
+
+    def test_from_checkpoint_autowires_drift(self, tmp_path):
+        """A health+drift-configured checkpoint wires the monitor up at
+        engine construction without any enable_drift call."""
+        tr, cfg = build_smoke_trainer(tmp_path, enabled=True, drift=True,
+                                      out=str(tmp_path / "h"))
+        train_and_load(tr)
+        fc = Forecaster.from_checkpoint(tr.best_path)
+        assert fc.health_baseline is not None
+        assert fc.config.health.drift
+        sup = SupportConfig(cfg.model.kernel_type, cfg.model.K).build_all(
+            tr.dataset.adjs.values())
+        eng = ServingEngine.from_forecaster(
+            fc, np.asarray(sup, np.float32)[: cfg.model.m_graphs],
+            config=ServingConfig(buckets=(1, 2), max_batch=2,
+                                 max_delay_ms=5.0))
+        try:
+            assert eng.drift is not None
+            assert eng.drift.bins == fc.health_baseline["bins"]
+        finally:
+            eng.close()
+
+
+# -- the free-when-off jaxpr pin ---------------------------------------
+
+
+class TestFreeWhenOff:
+    def test_plain_series_superstep_program_unchanged(self):
+        """The health variant existing must cost the plain program
+        NOTHING: jax.make_jaxpr does no DCE, so the pinned primitive
+        count of the health-off window-free superstep is proof the plain
+        path's jaxpr is byte-for-byte the pre-health program. If this
+        moves, rerun `stmgcn lint --rebaseline` ONLY after confirming the
+        change is deliberate."""
+        from stmgcn_tpu.analysis.jaxpr_check import (
+            PRIMITIVE_BUDGETS,
+            count_primitives,
+            _trace_step_jaxprs,
+        )
+
+        jaxprs = _trace_step_jaxprs("smoke")
+        plain = count_primitives(jaxprs["train_series_superstep"])
+        health = count_primitives(jaxprs["train_series_superstep_health"])
+        assert plain == 455  # the pre-health measurement, exactly
+        # the health program is a registered contract of its own
+        assert "train_series_superstep_health" in PRIMITIVE_BUDGETS
+        assert plain < health <= PRIMITIVE_BUDGETS[
+            "train_series_superstep_health"]

@@ -29,7 +29,13 @@ from stmgcn_tpu.obs.registry import (
     MetricsRegistry,
     Reservoir,
 )
-from stmgcn_tpu.obs.report import load_trace, render_table, summarize
+from stmgcn_tpu.obs.cli import health_main
+from stmgcn_tpu.obs.report import (
+    chrome_trace,
+    load_trace,
+    render_table,
+    summarize,
+)
 from stmgcn_tpu.obs.trace import SCHEMA_VERSION, Tracer
 
 
@@ -355,6 +361,42 @@ class TestObsCli:
         err = capsys.readouterr().err
         assert rc == 2 and "cannot read" in err
 
+    def test_chrome_format_is_one_trace_document(self, tmp_path, capsys):
+        """--format chrome emits ONE ``chrome://tracing`` /
+        ui.perfetto.dev JSON document on stdout and nothing else."""
+        rc = obs_main([self._trace(tmp_path), "--format", "chrome"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.count("\n") == 1 and out.endswith("\n")
+        doc = json.loads(out)
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        assert len(events) == 2
+        assert all(e["ph"] == "X" for e in events)
+        assert {"schema_version", "capacity", "dropped"} <= set(
+            doc["otherData"])
+
+    def test_chrome_trace_track_assignment_and_units(self):
+        """Trace-event ts/dur are MICROSECONDS (span fields are ms);
+        overlapping roots land on distinct derived tracks, children
+        inherit their root's track, sequential roots reuse track 0."""
+        spans = [
+            {"id": 1, "parent": 0, "name": "a", "ts": 0.0, "dur_ms": 5.0},
+            {"id": 2, "parent": 1, "name": "a.child", "ts": 1.0,
+             "dur_ms": 2.0, "attrs": {"k": 1}},
+            {"id": 3, "parent": 0, "name": "b", "ts": 2.0, "dur_ms": 2.0},
+            {"id": 4, "parent": 0, "name": "c", "ts": 6.0, "dur_ms": 1.0},
+        ]
+        doc = chrome_trace({"schema_version": 1, "capacity": 8,
+                            "dropped": 0}, spans)
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        assert by_name["a"]["ts"] == 0.0 and by_name["a"]["dur"] == 5000.0
+        assert by_name["a"]["tid"] == 0
+        assert by_name["a.child"]["tid"] == 0  # child rides its root
+        assert by_name["a.child"]["args"] == {"k": 1}
+        assert by_name["b"]["tid"] == 1  # overlaps a -> new track
+        assert by_name["c"]["tid"] == 0  # a ended -> track 0 free again
+
     def test_obs_package_is_lean(self):
         """Importing stmgcn_tpu.obs must not pull jax (serving/export
         import it at module scope; their leanness contracts inherit)."""
@@ -371,6 +413,54 @@ class TestObsCli:
             timeout=120,
         )
         assert out.stdout.strip() == "LEAN", out.stderr
+
+
+class TestHealthCli:
+    """``stmgcn health PATH``: same stdout contract family as obs —
+    text renders the fixed-width report, --format json is EXACTLY one
+    machine-parseable line, unreadable input exits 2."""
+
+    def _health(self, tmp_path):
+        from stmgcn_tpu.obs.health import HealthWriter
+
+        path = str(tmp_path / "health.jsonl")
+        w = HealthWriter(path, {"every_k": 1, "groups": ["lstm"]})
+        w.write({"kind": "train", "epoch": 0, "step": 2, "steps": 2,
+                 "loss": 0.5, "grad_norm": 1.25, "update_ratio": 1e-3,
+                 "nonfinite_grads": 0, "nonfinite_loss": 0,
+                 "group_norms": {"lstm": 0.7}})
+        w.write({"kind": "drift", "city": "0", "phase": "input",
+                 "z_max": 3.0, "psi": 0.02, "n": 64, "generation": 0})
+        w.close()
+        return path
+
+    def test_json_format_is_one_line(self, tmp_path, capsys):
+        rc = health_main([self._health(tmp_path), "--format", "json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.count("\n") == 1 and out.endswith("\n")
+        doc = json.loads(out)
+        assert doc["meta"]["every_k"] == 1
+        assert doc["summary"]["train"]["count"] == 1
+        assert doc["summary"]["drift"]["worst"]["city"] == "0"
+        assert "records" not in doc  # only with --dump
+
+    def test_json_dump_includes_records(self, tmp_path, capsys):
+        rc = health_main([self._health(tmp_path), "--format", "json",
+                          "--dump"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and len(doc["records"]) == 2
+
+    def test_text_renders_report(self, tmp_path, capsys):
+        rc = health_main([self._health(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "grad_norm[lstm]" in out and "drift:" in out
+
+    def test_missing_file_exits_2(self, tmp_path, capsys):
+        rc = health_main([str(tmp_path / "nope.jsonl")])
+        err = capsys.readouterr().err
+        assert rc == 2 and "cannot read" in err
 
 
 # -- EngineStats: bounded reservoirs + cold-start fallback --------------

@@ -12,7 +12,9 @@
 #    "obs": {"exit": N, "recompiles_after_warmup": N|null,
 #    "trace_spans": N|null},
 #    "health": {"exit": N, "nonfinite": N|null, "records": N|null,
-#    "findings": N|null}}
+#    "findings": N|null},
+#    "continual": {"exit": N, "promotions": N|null, "rejections": N|null,
+#    "nonfinite": N|null}}
 #
 # The "concurrency" section is explicit evidence the static concurrency
 # pass (unguarded-attr / lock-order-cycle / condvar-discipline /
@@ -128,10 +130,31 @@ EOF
 obs_exit=$?
 printf '%s\n' "$obs_json" >&2
 
+# Closed-loop continual drill: live ring ingest + a triggered fine-tune
+# + the guarded promotion gate, with one poisoned candidate. The gate
+# requires exactly one promotion, exactly one typed rejection, and a
+# ZERO-nonfinite health stream on the clean fine-tune — the loop's
+# supervision story exercised end-to-end, not asserted from unit tests
+# alone.
+continual_json=$(JAX_PLATFORMS=cpu "$PY" - <<'EOF' 2>>/dev/stderr
+import json
+import tempfile
+
+from stmgcn_tpu.train.continual import closed_loop_smoke
+
+with tempfile.TemporaryDirectory(prefix="stmgcn_continual_") as tmp:
+    out = closed_loop_smoke(tmp)
+print(json.dumps(out))
+EOF
+)
+continual_exit=$?
+printf '%s\n' "$continual_json" >&2
+
 LINT_JSON="$lint_json" LINT_EXIT="$lint_exit" \
 CONC_JSON="$conc_json" CONC_EXIT="$conc_exit" \
 RUFF_AVAILABLE="$ruff_available" RUFF_EXIT="$ruff_exit" \
 OBS_JSON="$obs_json" OBS_EXIT="$obs_exit" \
+CONTINUAL_JSON="$continual_json" CONTINUAL_EXIT="$continual_exit" \
 "$PY" - <<'EOF'
 import json
 import os
@@ -155,6 +178,11 @@ try:
 except ValueError:
     conc = {}
 conc_exit = int(os.environ["CONC_EXIT"])
+try:
+    continual = json.loads(os.environ["CONTINUAL_JSON"])
+except ValueError:
+    continual = {}
+continual_exit = int(os.environ["CONTINUAL_EXIT"])
 
 ok = lint_exit == 0 and report.get("errors") == 0
 # concurrency pass must have run over a real class model and come back
@@ -170,6 +198,13 @@ ok = ok and obs_exit == 0 and recompiles == 0
 ok = ok and obs.get("health_nonfinite") == 0
 ok = ok and (obs.get("health_records") or 0) > 0
 ok = ok and obs.get("health_findings") == 0
+# continual loop: the clean fine-tune promoted (exactly one), the
+# poisoned one rejected at the gate (exactly one), zero nonfinite
+# observations in the clean health stream
+ok = ok and continual_exit == 0
+ok = ok and continual.get("promotions") == 1
+ok = ok and continual.get("rejections") == 1
+ok = ok and continual.get("nonfinite") == 0
 print(json.dumps({
     "gate": "PASS" if ok else "FAIL",
     "lint": {
@@ -195,6 +230,12 @@ print(json.dumps({
         "nonfinite": obs.get("health_nonfinite"),
         "records": obs.get("health_records"),
         "findings": obs.get("health_findings"),
+    },
+    "continual": {
+        "exit": continual_exit,
+        "promotions": continual.get("promotions"),
+        "rejections": continual.get("rejections"),
+        "nonfinite": continual.get("nonfinite"),
     },
 }))
 sys.exit(0 if ok else 1)

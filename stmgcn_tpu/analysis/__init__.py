@@ -59,6 +59,7 @@ Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 
 from stmgcn_tpu.analysis.collective_check import check_collective_contracts
 from stmgcn_tpu.analysis.concurrency_check import check_concurrency
+from stmgcn_tpu.analysis.continual_check import check_continual_config
 from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
 from stmgcn_tpu.analysis.health_check import check_health_overhead
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
@@ -82,6 +83,7 @@ __all__ = [
     "Rule",
     "check_collective_contracts",
     "check_concurrency",
+    "check_continual_config",
     "check_fleet_shape_classes",
     "check_health_overhead",
     "check_obs_overhead",

@@ -1,0 +1,80 @@
+"""Pass 2j: continual-loop contracts — closed-loop config math.
+
+The continual loop (:mod:`stmgcn_tpu.train.continual`) is the one
+subsystem designed to run *unattended*: a config mistake does not fail
+a job, it degrades a service — a ring sized past the per-core resident
+budget OOMs serving, a retrain cadence the measured superstep cannot
+sustain starves the dispatch path, a drift-only trigger with no
+baseline never retrains at all, and a malformed promotion gate either
+rejects every candidate or (worse) promotes anything. The per-config
+arithmetic is ``ContinualConfig.violations()``; this pass evaluates it
+per preset with the cross-cutting inputs wired in: row bytes from the
+preset's data shape, the budget from ``Trainer.RESIDENT_CAP_BYTES``
+(imported lazily, same as the ``resident-memory`` pass), and the
+sibling health/data configs for the cross-field checks. Pure config
+math — no JAX, no trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_continual_config"]
+
+#: demand channels and storage dtype — lockstep with resident_check.py
+_CHANNELS = 1
+_ITEMSIZE = 4
+
+
+def check_continual_config(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+    budget_bytes: Optional[int] = None,
+) -> List[Finding]:
+    """Validate every preset's continual-loop knobs.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset. One finding per violation string.
+    """
+    from stmgcn_tpu.config import PRESETS
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+    if budget_bytes is None:
+        # lazy: the check must not pull the trainer (and jax) at import
+        from stmgcn_tpu.train.trainer import Trainer
+
+        budget_bytes = Trainer.RESIDENT_CAP_BYTES
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="continual-config",
+                path=f"<contract:continual:{name}>",
+                line=0,
+                message=message,
+                severity=RULES["continual-config"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        cont = getattr(cfg, "continual", None)
+        if cont is None:
+            continue
+        data = getattr(cfg, "data", None)
+        row_bytes = None
+        if data is not None:
+            cols = data.cols if data.cols is not None else data.rows
+            row_bytes = data.rows * cols * _CHANNELS * _ITEMSIZE
+        for violation in cont.violations(
+            row_bytes=row_bytes,
+            budget_bytes=budget_bytes,
+            health=getattr(cfg, "health", None),
+            data=data,
+        ):
+            emit(name, f"{name}: {violation}")
+    return findings

@@ -187,6 +187,18 @@ _ALL_RULES = [
         "config math, detectable before any step runs",
     ),
     Rule(
+        "continual-config",
+        "error",
+        "a preset's continual-loop knobs cannot run unattended (ring "
+        "sized past the per-core resident budget or too small for one "
+        "training window, retrain cadence the measured superstep time "
+        "cannot sustain without starving serving, promotion-gate "
+        "thresholds missing or unordered, or a drift-only trigger with "
+        "no health baseline to fire against) — "
+        "ContinualConfig.violations() config math, detectable before "
+        "any step runs",
+    ),
+    Rule(
         "pallas-blockspec",
         "error",
         "a pl.pallas_call BlockSpec/grid disagrees with its operand "

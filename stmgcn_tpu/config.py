@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from stmgcn_tpu.ops.graph import SupportConfig, support_count
 
 __all__ = [
+    "ContinualConfig",
     "DataConfig",
     "ExperimentConfig",
     "HealthConfig",
@@ -567,6 +568,169 @@ class HealthConfig:
 
 
 @dataclasses.dataclass
+class ContinualConfig:
+    """Closed-loop continual learning knobs (ring ingest, retrain daemon,
+    guarded promotion — :mod:`stmgcn_tpu.train.continual`).
+
+    Off by default — with ``enabled=False`` the serving/training paths
+    are exactly the loop-free build (parity pinned in
+    tests/test_continual.py). ``violations()`` is the pure-config
+    contract behind the ``continual-config`` lint rule: a ring bigger
+    than the per-core resident budget, a retrain cadence the measured
+    superstep time cannot sustain without starving serving, missing or
+    unordered promotion-gate thresholds, and a drift trigger with no
+    baseline to fire against are all deployment outages detectable
+    before any step runs.
+    """
+
+    #: run the continual-training daemon (the ring itself can be used
+    #: standalone — e.g. pre-filled for window-free serving)
+    enabled: bool = False
+    #: ring rows (timesteps) resident on device per city
+    ring_capacity: int = 1024
+    #: how many steps behind the head a late row may arrive and still be
+    #: placed; older is a typed reject. Must be < ring_capacity
+    reorder_window: int = 4
+    #: wall-clock retrain cadence in seconds; 0 = drift-triggered only
+    cadence_s: float = 0.0
+    #: retrain when any city's drift z_max gauge crosses this
+    drift_z_max: float = 8.0
+    #: retrain when any city's drift PSI gauge crosses this
+    drift_psi: float = 0.5
+    #: fused supersteps per fine-tune round
+    finetune_steps: int = 8
+    #: microbatch size of the fine-tune superstep
+    finetune_batch: int = 8
+    #: train on only the freshest K targets; 0 = whole resident series
+    finetune_window: int = 0
+    #: consecutive daemon failures tolerated before it stays down
+    max_restarts: int = 3
+    #: initial retry backoff (doubles per failure, with jitter)
+    backoff_s: float = 0.25
+    #: backoff ceiling; must be >= backoff_s
+    backoff_max_s: float = 4.0
+    #: gate: reject a candidate whose fine-tune grad norm exceeded this
+    promote_grad_norm_max: float = 1e3
+    #: gate: reject a candidate whose update ratio exceeded this
+    promote_update_ratio_max: float = 0.5
+    #: gate: reject a candidate whose held-out eval loss exceeds the
+    #: live generation's by more than this relative margin
+    promote_eval_margin: float = 0.05
+    #: measured fused-superstep wall time (ms) for the duty-cycle check;
+    #: 0 = not yet measured (check skipped)
+    superstep_ms: float = 0.0
+    #: largest fraction of the cadence the fine-tune may occupy — above
+    #: this the daemon starves serving on a shared core
+    max_duty: float = 0.5
+
+    def violations(self, *, row_bytes: Optional[int] = None,
+                   budget_bytes: Optional[int] = None,
+                   health=None, data=None) -> list:
+        """Every way this config breaks the closed-loop deployment
+        contract (empty list = valid; the ``continual-config`` rule).
+        Ring bounds always apply — a pre-filled ring exists with the
+        daemon off; trigger/retry/gate checks only matter once the loop
+        is enabled. ``row_bytes``/``budget_bytes`` bring in the
+        ``resident-memory`` per-core budget; ``health``/``data`` bring
+        in the sibling configs for cross-field checks (drift trigger
+        needs a baseline; the ring must cover one training window).
+        """
+        v = []
+        if self.ring_capacity < 1:
+            v.append(
+                f"ring_capacity must be >= 1, got {self.ring_capacity} — "
+                "an empty ring can never hold a series"
+            )
+        elif not 0 <= self.reorder_window < self.ring_capacity:
+            v.append(
+                f"reorder_window {self.reorder_window} must be in "
+                f"[0, ring_capacity={self.ring_capacity}) — a late row "
+                "can only overwrite a slot that is still resident"
+            )
+        if row_bytes is not None and budget_bytes is not None:
+            need = self.ring_capacity * row_bytes
+            if need > budget_bytes:
+                v.append(
+                    f"ring_capacity {self.ring_capacity} needs {need} "
+                    f"resident bytes ({row_bytes} B/row) — over the "
+                    f"per-core resident budget {budget_bytes}"
+                )
+        if data is not None and self.ring_capacity >= 1:
+            from stmgcn_tpu.data.windowing import WindowSpec
+
+            spec = WindowSpec(data.serial_len, data.daily_len,
+                              data.weekly_len, data.day_timesteps,
+                              horizon=data.horizon)
+            need = spec.burn_in + spec.horizon
+            if self.ring_capacity < need:
+                v.append(
+                    f"ring_capacity {self.ring_capacity} cannot hold one "
+                    f"training window — burn_in+horizon is {need} for "
+                    "this window spec, so the fine-tune would never have "
+                    "a valid target"
+                )
+        if not self.enabled:
+            return v
+        if self.cadence_s < 0:
+            v.append(f"cadence_s must be >= 0, got {self.cadence_s}")
+        if self.cadence_s == 0 and health is not None and not (
+            health.drift and health.baseline
+        ):
+            v.append(
+                "cadence_s=0 makes drift gauges the only retrain trigger, "
+                "but health.drift/health.baseline are not both on — the "
+                "daemon would never fire"
+            )
+        if self.drift_z_max <= 0 or self.drift_psi <= 0:
+            v.append(
+                f"drift thresholds must be positive, got z_max="
+                f"{self.drift_z_max}, psi={self.drift_psi} — a "
+                "non-positive threshold retrains on every poll"
+            )
+        if self.finetune_steps < 1 or self.finetune_batch < 1:
+            v.append(
+                f"finetune_steps/finetune_batch must be >= 1, got "
+                f"{self.finetune_steps}/{self.finetune_batch}"
+            )
+        if self.finetune_window < 0:
+            v.append(
+                f"finetune_window must be >= 0, got {self.finetune_window}"
+            )
+        if self.max_restarts < 0:
+            v.append(f"max_restarts must be >= 0, got {self.max_restarts}")
+        if self.backoff_s <= 0 or self.backoff_max_s < self.backoff_s:
+            v.append(
+                f"retry backoff must satisfy 0 < backoff_s <= "
+                f"backoff_max_s, got {self.backoff_s}/{self.backoff_max_s}"
+            )
+        if self.promote_grad_norm_max <= 0 or self.promote_update_ratio_max <= 0:
+            v.append(
+                "promotion-gate bands must be positive, got grad_norm_max="
+                f"{self.promote_grad_norm_max}, update_ratio_max="
+                f"{self.promote_update_ratio_max} — a non-positive band "
+                "rejects every candidate"
+            )
+        if self.promote_eval_margin < 0:
+            v.append(
+                f"promote_eval_margin must be >= 0, got "
+                f"{self.promote_eval_margin} — a negative margin demands "
+                "the candidate be strictly better than live to even tie"
+            )
+        if not 0 < self.max_duty <= 1:
+            v.append(f"max_duty must be in (0, 1], got {self.max_duty}")
+        elif self.cadence_s > 0 and self.superstep_ms > 0:
+            duty = (self.finetune_steps * self.superstep_ms / 1e3) / self.cadence_s
+            if duty > self.max_duty:
+                v.append(
+                    f"fine-tune duty cycle {duty:.2f} exceeds max_duty "
+                    f"{self.max_duty} — {self.finetune_steps} supersteps "
+                    f"x {self.superstep_ms} ms every {self.cadence_s} s "
+                    "starves serving on a shared core"
+                )
+        return v
+
+
+@dataclasses.dataclass
 class ExperimentConfig:
     name: str = "default"
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
@@ -576,6 +740,7 @@ class ExperimentConfig:
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
+    continual: ContinualConfig = dataclasses.field(default_factory=ContinualConfig)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -591,6 +756,7 @@ class ExperimentConfig:
             serving=ServingConfig(**d.get("serving", {})),
             obs=ObsConfig(**d.get("obs", {})),
             health=HealthConfig(**d.get("health", {})),
+            continual=ContinualConfig(**d.get("continual", {})),
         )
 
 

@@ -12,6 +12,7 @@ from stmgcn_tpu.data.loader import ADJ_KEYS, DemandData, load_npz
 from stmgcn_tpu.data.normalize import MinMaxNormalizer, StdNormalizer, normalizer_from_dict
 from stmgcn_tpu.data.pipeline import DemandDataset, Batch
 from stmgcn_tpu.data.hetero import HeteroCityDataset
+from stmgcn_tpu.data.ring import SeriesRing, StaleObservationError, ingest_stream
 from stmgcn_tpu.data.fleet import FleetPlan, ShapeClass, plan_shape_classes
 from stmgcn_tpu.data.splits import SplitSpec, date_splits
 from stmgcn_tpu.data.synthetic import synthetic_demand, grid_adjacency, synthetic_dataset
@@ -25,12 +26,15 @@ __all__ = [
     "FleetPlan",
     "HeteroCityDataset",
     "MinMaxNormalizer",
+    "SeriesRing",
     "ShapeClass",
+    "StaleObservationError",
     "StdNormalizer",
     "SplitSpec",
     "WindowSpec",
     "date_splits",
     "grid_adjacency",
+    "ingest_stream",
     "load_npz",
     "normalizer_from_dict",
     "plan_shape_classes",

@@ -1,0 +1,369 @@
+"""Device-resident ingest ring: the live-feed end of the closed loop.
+
+The window-free resident path (``DemandDataset.series`` +
+``gather_window_batch``) already trains and serves from a normalized
+``(T, N, C)`` series that lives on device; what it lacks is a way to
+*append* to that series without re-uploading full history. This module
+closes that gap: :class:`SeriesRing` keeps the freshest ``capacity``
+timesteps of one city's normalized series as a ring buffer updated in
+place by a single jitted program (``lax.dynamic_update_slice`` with a
+*traced* slot index, so ingest compiles exactly once and every
+subsequent row is a compile-free device write), while the host side
+keeps the monotonic-timestamp bookkeeping a real feed needs:
+
+- **gaps** — a timestamp jump forward-fills the missing slots with the
+  last observed row (counted per missing step), so the gather offsets
+  of :func:`~stmgcn_tpu.train.step.make_series_superstep_fns` stay
+  valid index arithmetic: logical row ``i`` is *always* timestamp
+  ``origin_ts + i``.
+- **out-of-order rows** — a late arrival within ``reorder_window``
+  steps overwrites its (still-resident) slot in place; older than that
+  it is a typed reject (:class:`StaleObservationError`), never a silent
+  drop and never a corrupted timeline.
+- **duplicates** — re-delivery of a timestamp that already holds a real
+  observation is dropped and counted (the at-least-once transport
+  case).
+- **nonfinite observations** — quarantined on the host (bounded list of
+  ``(ts, reason)``) and counted; the slot forward-fills so NaN never
+  reaches the device buffer and the timeline still advances.
+
+Because logical index == timestamp offset, "train on the last K hours"
+is just an index range (:meth:`SeriesRing.target_indices` with
+``last=K``) and a predict request shrinks from a full-history upload to
+``(city, region ids, timestamp)`` — :meth:`SeriesRing.window_at`
+gathers the model input for a timestamp straight from ring contents.
+
+Ingest-stage fault drills run through
+:class:`~stmgcn_tpu.resilience.IngestFaultPlan` via
+:func:`ingest_stream`; an absent/empty plan is byte-for-byte the
+production path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stmgcn_tpu.obs.registry import REGISTRY
+
+__all__ = ["SeriesRing", "StaleObservationError", "ingest_stream"]
+
+
+class StaleObservationError(ValueError):
+    """A row arrived too late to place: older than the ring's reorder
+    window (or before the ring's first timestamp entirely). Typed so
+    feed drivers can count/route rejects without pattern-matching
+    message strings."""
+
+
+def _ingest_program(buf, row, slot):
+    """One in-place ring write. ``slot`` is traced (a device scalar), so
+    every row of a ring's lifetime reuses the single compiled program —
+    the zero-recompiles-after-warmup property the smoke drill pins."""
+    return jax.lax.dynamic_update_slice(buf, row[None], (slot, 0, 0))
+
+
+# buf is donated: ingest really is an in-place update, not a copy chain.
+_INGEST = jax.jit(_ingest_program, donate_argnums=(0,))
+_ROLL = jax.jit(lambda buf, shift: jnp.roll(buf, -shift, axis=0))
+
+
+class SeriesRing:
+    """Ring buffer holding the freshest ``capacity`` rows of one city's
+    normalized ``(T, N, C)`` series on device.
+
+    Logical contract: :meth:`series` returns rows in time order, row
+    ``i`` being timestamp ``origin_ts + i`` — bit-identical to the slice
+    ``full_series[-L:]`` a host-side feed would produce (pinned against
+    a numpy oracle in tests/test_ring.py). All anomaly handling
+    (gap/out-of-order/duplicate/nonfinite) happens on the host *before*
+    the device write, so the device buffer only ever holds finite,
+    time-ordered data.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        n_nodes: int,
+        n_feats: int,
+        *,
+        reorder_window: int = 4,
+        start_ts: Optional[int] = None,
+        city: int = 0,
+        registry=None,
+        max_quarantine: int = 64,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if not 0 <= reorder_window < capacity:
+            raise ValueError(
+                f"reorder_window must be in [0, capacity), got "
+                f"{reorder_window} for capacity {capacity}"
+            )
+        self.capacity = int(capacity)
+        self.n_nodes = int(n_nodes)
+        self.n_feats = int(n_feats)
+        self.reorder_window = int(reorder_window)
+        self.city = int(city)
+        self.start_ts: Optional[int] = None if start_ts is None else int(start_ts)
+        #: rows ever committed (real + forward-fills); the ts<->index map
+        self.count = 0
+        self.rows = 0
+        self.gaps = 0
+        self.out_of_order = 0
+        self.duplicates = 0
+        self.nonfinite = 0
+        #: most recent quarantined observations, newest last
+        self.quarantined: list[Tuple[int, str]] = []
+        self.max_quarantine = int(max_quarantine)
+        self._buf = jnp.zeros((self.capacity, n_nodes, n_feats), jnp.float32)
+        self._last_row: Optional[np.ndarray] = None
+        self._real: set[int] = set()
+        reg = REGISTRY if registry is None else registry
+        labels = {"city": str(self.city)}
+        self._c_rows = reg.counter("ingest.rows", labels)
+        self._c_gaps = reg.counter("ingest.gaps", labels)
+        self._c_ooo = reg.counter("ingest.out_of_order", labels)
+        self._c_dup = reg.counter("ingest.duplicates", labels)
+        self._c_nonfinite = reg.counter("ingest.nonfinite", labels)
+        self._g_occupancy = reg.gauge("ring.occupancy", labels)
+
+    # ------------------------------------------------------------------
+    # construction from an existing series (loop-off / pre-fill path)
+
+    @classmethod
+    def from_series(cls, series, *, start_ts: int = 0,
+                    capacity: Optional[int] = None, **kwargs) -> "SeriesRing":
+        """Pre-fill a ring from an existing ``(T, N, C)`` series.
+
+        With ``capacity >= T`` (the default: exactly ``T``),
+        :meth:`series` returns the input bit-identically — the loop-off
+        parity case. With ``capacity < T`` only the freshest rows are
+        resident, exactly as if every row had been ingested live.
+        """
+        arr = np.asarray(series, dtype=np.float32)
+        if arr.ndim != 3:
+            raise ValueError(f"series must be (T, N, C), got {arr.shape}")
+        T, n, c = arr.shape
+        cap = T if capacity is None else int(capacity)
+        ring = cls(cap, n, c, start_ts=start_ts, **kwargs)
+        keep = arr[-cap:]
+        g0 = T - keep.shape[0]
+        buf = np.zeros((cap, n, c), dtype=np.float32)
+        buf[(np.arange(g0, T) % cap)] = keep
+        ring._buf = jnp.asarray(buf)
+        ring.count = T
+        ring.rows = T
+        ring._last_row = arr[-1].copy()
+        last_ts = start_ts + T - 1
+        ring._real = {t for t in range(last_ts - ring.reorder_window, last_ts + 1)
+                      if t >= start_ts}
+        ring._c_rows.inc(T)
+        ring._g_occupancy.set(min(T, cap) / cap)
+        return ring
+
+    # ------------------------------------------------------------------
+    # properties
+
+    def __len__(self) -> int:
+        """Logical length: resident rows (<= capacity)."""
+        return min(self.count, self.capacity)
+
+    @property
+    def next_ts(self) -> Optional[int]:
+        """Timestamp the next in-order row should carry."""
+        return None if self.start_ts is None else self.start_ts + self.count
+
+    @property
+    def origin_ts(self) -> Optional[int]:
+        """Timestamp of logical row 0 (the ring's logical origin)."""
+        if self.start_ts is None:
+            return None
+        return self.start_ts + self.count - len(self)
+
+    @property
+    def nbytes(self) -> int:
+        """Device-resident footprint of the ring buffer."""
+        return self.capacity * self.n_nodes * self.n_feats * 4
+
+    # ------------------------------------------------------------------
+    # ingest
+
+    def _commit(self, row: np.ndarray) -> None:
+        # Device write first, host bookkeeping after: a SIGTERM between
+        # the two leaves the new row outside the logical window (count
+        # not yet advanced), so the ring's visible state stays a valid,
+        # fully-written series — the mid-ingest preemption invariant.
+        slot = self.count % self.capacity
+        self._buf = _INGEST(self._buf, jnp.asarray(row),
+                            jnp.asarray(slot, jnp.int32))
+        self.count += 1
+
+    def ingest(self, ts: int, values) -> str:
+        """Feed one observation row; returns what happened to it.
+
+        Outcomes: ``"append"`` (in-order commit), ``"gap-fill"``
+        (in-order commit after forward-filling missing timestamps),
+        ``"late"`` (out-of-order slot overwrite inside the reorder
+        window), ``"duplicate"`` (dropped re-delivery), ``"nonfinite"``
+        (quarantined, slot forward-filled). Rows older than the reorder
+        window raise :class:`StaleObservationError`.
+        """
+        ts = int(ts)
+        row = np.asarray(values, dtype=np.float32)
+        if row.shape != (self.n_nodes, self.n_feats):
+            raise ValueError(
+                f"row must be ({self.n_nodes}, {self.n_feats}), got {row.shape}"
+            )
+        if self.start_ts is None:
+            self.start_ts = ts
+        outcome = self._place(ts, row)
+        self._g_occupancy.set(len(self) / self.capacity)
+        return outcome
+
+    def _place(self, ts: int, row: np.ndarray) -> str:
+        nxt = self.start_ts + self.count
+        finite = bool(np.isfinite(row).all())
+        if not finite:
+            self.nonfinite += 1
+            self._c_nonfinite.inc()
+            self.quarantined.append((ts, "nonfinite"))
+            del self.quarantined[: -self.max_quarantine]
+            if ts < nxt:
+                return "nonfinite"  # late *and* broken: nothing to place
+            self._fill_to(ts + 1)  # forward-fill through the bad slot
+            return "nonfinite"
+        if ts >= nxt:
+            missing = ts - nxt
+            if missing:
+                self._fill_to(ts)
+                self.gaps += missing
+                self._c_gaps.inc(missing)
+            self._commit(row)
+            self._last_row = row.copy()
+            self._note_real(ts)
+            self.rows += 1
+            self._c_rows.inc()
+            return "gap-fill" if missing else "append"
+        # late arrival: staleness is decided first — beyond the reorder
+        # window even a re-delivery is a typed reject (the _real set is
+        # pruned to the window, so dedupe past it would be unreliable)
+        if ts < self.start_ts or nxt - ts > self.reorder_window:
+            raise StaleObservationError(
+                f"row at ts={ts} is {nxt - ts} steps behind the ring head "
+                f"(reorder window {self.reorder_window}) — too stale to place"
+            )
+        if ts in self._real:
+            self.duplicates += 1
+            self._c_dup.inc()
+            return "duplicate"
+        slot = (ts - self.start_ts) % self.capacity
+        self._buf = _INGEST(self._buf, jnp.asarray(row),
+                            jnp.asarray(slot, jnp.int32))
+        self._note_real(ts)
+        self.out_of_order += 1
+        self._c_ooo.inc()
+        self.rows += 1
+        self._c_rows.inc()
+        return "late"
+
+    def _fill_to(self, ts: int) -> None:
+        """Forward-fill committed slots up to (excluding) ``ts``. Fills
+        beyond one full capacity are skipped device-side (they would be
+        overwritten before ever becoming visible) but still advance
+        ``count`` so the ts<->index map stays exact."""
+        missing = ts - (self.start_ts + self.count)
+        skip = max(0, missing - self.capacity)
+        self.count += skip
+        fill = (self._last_row if self._last_row is not None
+                else np.zeros((self.n_nodes, self.n_feats), np.float32))
+        for _ in range(missing - skip):
+            self._commit(fill)
+
+    def _note_real(self, ts: int) -> None:
+        self._real.add(ts)
+        if len(self._real) > 4 * (self.reorder_window + 1):
+            head = self.start_ts + self.count
+            self._real = {t for t in self._real
+                          if t >= head - self.reorder_window - 1}
+
+    # ------------------------------------------------------------------
+    # reading
+
+    def series(self, last: Optional[int] = None) -> jax.Array:
+        """The resident series ``(L, N, C)`` in logical time order
+        (``last=K`` trims to the freshest K rows). One device roll when
+        the ring has wrapped; a plain slice before that."""
+        L = len(self)
+        if self.count <= self.capacity:
+            view = self._buf[:L]
+        else:
+            view = _ROLL(self._buf, jnp.asarray(self.count % self.capacity,
+                                                jnp.int32))
+        if last is not None:
+            view = view[-min(int(last), L):]
+        return view
+
+    def index_of(self, ts: int) -> int:
+        """Logical index of timestamp ``ts`` in :meth:`series`."""
+        if self.start_ts is None:
+            raise ValueError("ring is empty")
+        i = int(ts) - self.origin_ts
+        if not 0 <= i < len(self):
+            raise StaleObservationError(
+                f"ts={ts} is not resident (ring spans "
+                f"[{self.origin_ts}, {self.origin_ts + len(self) - 1}])"
+            )
+        return i
+
+    def target_indices(self, spec, last: Optional[int] = None) -> np.ndarray:
+        """Valid superstep target indices into :meth:`series` — "train on
+        the last K hours" as an index range (``last=K`` keeps only the
+        freshest K targets). Same enumeration as
+        ``WindowSpec.target_indices`` over the resident length."""
+        L = len(self)
+        if L <= spec.burn_in + spec.horizon - 1:
+            raise ValueError(
+                f"ring holds {L} rows; need more than "
+                f"burn_in+horizon-1={spec.burn_in + spec.horizon - 1}"
+            )
+        idx = spec.target_indices(L).astype(np.int32)
+        if last is not None:
+            idx = idx[-int(last):]
+        return idx
+
+    def window_at(self, spec, ts: int) -> np.ndarray:
+        """Model input window ``(seq_len, N, C)`` for predicting
+        timestamp ``ts`` — the shrunken predict request: the caller
+        ships ``(city, ts)`` and the ring supplies the history."""
+        t = self.index_of(ts)
+        if t < spec.burn_in:
+            raise StaleObservationError(
+                f"ts={ts} has only {t} resident history rows; the window "
+                f"needs {spec.burn_in}"
+            )
+        return np.asarray(jnp.take(self.series(), t + spec.offsets, axis=0))
+
+
+def ingest_stream(ring: SeriesRing, rows: Iterable[Tuple[int, np.ndarray]],
+                  fault_plan=None) -> dict:
+    """Drive a feed of ``(ts, values)`` rows into ``ring``, optionally
+    through an :class:`~stmgcn_tpu.resilience.IngestFaultPlan` (absent or
+    empty plan = production pass-through). Stale rows are counted, not
+    raised — a live feed must survive its transport. Returns
+    ``{"fed", "accepted", "rejected"}``."""
+    summary = {"fed": 0, "accepted": 0, "rejected": 0}
+    for ts, values in rows:
+        arrivals = ([(ts, values)] if fault_plan is None
+                    else fault_plan.feed(ts, values))
+        for ats, avalues in arrivals:
+            summary["fed"] += 1
+            try:
+                ring.ingest(ats, avalues)
+                summary["accepted"] += 1
+            except StaleObservationError:
+                summary["rejected"] += 1
+    return summary

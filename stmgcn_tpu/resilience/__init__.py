@@ -20,9 +20,14 @@ tested paths:
   ``except Exception`` recovery code cannot swallow a shutdown request.
 - :class:`ServeFaultPlan` / :class:`ServeFaultSpec` — the serving-side
   mirror: dispatch-addressed raise/slow/hang faults, batcher-thread
-  death (:class:`BatcherKilled`), and at-rest checkpoint corruption for
-  the hot-swap watcher, so every shed/degrade/swap path of the serving
-  engine is exercised deterministically too.
+  death (:class:`BatcherKilled`), at-rest checkpoint corruption for
+  the hot-swap watcher, and promotion-gate raises, so every
+  shed/degrade/swap/promote path of the serving engine is exercised
+  deterministically too.
+- :class:`IngestFaultPlan` / :class:`IngestFaultSpec` — the live-feed
+  mirror for the continual loop: a deterministic stream transformer
+  (gap / out-of-order / duplicate / nonfinite / SIGTERM by source-row
+  ordinal) applied before rows reach the device-resident ingest ring.
 
 The verified-checkpoint side (CRC32 format v2, ``load_latest_verified``
 recovery chain) lives in :mod:`stmgcn_tpu.train.checkpoint`.
@@ -32,6 +37,8 @@ from stmgcn_tpu.resilience.faults import (
     BatcherKilled,
     FaultPlan,
     FaultSpec,
+    IngestFaultPlan,
+    IngestFaultSpec,
     InjectedFault,
     Preempted,
     ServeFaultPlan,
@@ -45,6 +52,8 @@ __all__ = [
     "DivergenceGuard",
     "FaultPlan",
     "FaultSpec",
+    "IngestFaultPlan",
+    "IngestFaultSpec",
     "InjectedFault",
     "Preempted",
     "ServeFaultPlan",

@@ -26,9 +26,23 @@ The serving side gets the same treatment (:class:`ServeFaultPlan` /
 :class:`ServeFaultSpec`): faults address the micro-batcher's *dispatch
 ordinal* (0-based count of coalesced dispatches) instead of training
 steps, plus an at-rest checkpoint corruption hook the hot-swap watcher
-consults — so every shed/degrade/swap path in the serving engine is
+consults and a promotion-gate hook the continual-learning gate consults
+— so every shed/degrade/swap/promote path in the serving engine is
 exercised deterministically, and the empty plan is again a production
 no-op.
+
+The closed continual loop adds the last two stages. Ingest faults
+(:class:`IngestFaultPlan` / :class:`IngestFaultSpec`) are a
+deterministic *stream transformer* addressed by source-row ordinal:
+drop a row (gap), hold one back (out-of-order arrival), replay one
+(duplicate), poison one with NaN, or deliver SIGTERM mid-ingest —
+applied to the ``(timestamp, values)`` stream *before* it reaches the
+ring, because that is where real feeds break. Daemon faults reuse
+:class:`FaultPlan` with the retrain ordinal as the "epoch": raise /
+hang / poison mid-fine-tune plus the write kinds against candidate
+checkpoints, including ``torn-write`` — a crash *between* the tmp-file
+write and the atomic rename, the one window ``os.replace`` atomicity
+cannot cover from inside the process.
 """
 
 from __future__ import annotations
@@ -45,6 +59,9 @@ __all__ = [
     "BatcherKilled",
     "FaultPlan",
     "FaultSpec",
+    "INGEST_KINDS",
+    "IngestFaultPlan",
+    "IngestFaultSpec",
     "InjectedFault",
     "Preempted",
     "SERVE_KINDS",
@@ -52,8 +69,8 @@ __all__ = [
     "ServeFaultSpec",
 ]
 
-_STEP_KINDS = ("raise", "sigterm", "poison", "drop")
-_WRITE_KINDS = ("truncate-write", "corrupt-write")
+_STEP_KINDS = ("raise", "sigterm", "hang", "poison", "drop")
+_WRITE_KINDS = ("truncate-write", "corrupt-write", "torn-write")
 KINDS = _STEP_KINDS + _WRITE_KINDS
 SERVE_KINDS = (
     "dispatch-raise",
@@ -61,7 +78,9 @@ SERVE_KINDS = (
     "dispatch-hang",
     "batcher-die",
     "corrupt-checkpoint",
+    "promotion-raise",
 )
+INGEST_KINDS = ("gap", "out-of-order", "duplicate", "nonfinite", "sigterm")
 
 
 def _count_fault(kind: str) -> None:
@@ -106,6 +125,10 @@ class FaultSpec:
     - ``"sigterm"``  — deliver SIGTERM to this process before the step
       (``signal.raise_signal``): exercises the trainer's grace-window
       handler, emergency checkpoint, and :class:`Preempted` unwind.
+    - ``"hang"``     — sleep ``hang_ms`` before the step (one-shot): the
+      stalled-device / wedged-host stand-in for the continual daemon's
+      supervision drills — a fine-tune that hangs must never block the
+      serving path.
     - ``"poison"``   — inject ``payload`` (default NaN) into the batch's
       loss mask: the loss and every gradient go non-finite exactly as
       they would for NaN input data, tripping checkify/the divergence
@@ -120,12 +143,19 @@ class FaultSpec:
       serialized bytes.
     - ``"corrupt-write"``  — flip one bit of byte ``flip_byte``
       (-1 = middle of the file).
+    - ``"torn-write"``     — crash between the tmp-file write and the
+      atomic rename: the first ``keep_fraction`` of the bytes land in
+      the ``*.tmp.<pid>`` file, :class:`InjectedFault` fires before
+      ``os.replace``, and the destination file is never touched — the
+      window ``os.replace`` atomicity cannot cover, left as a documented
+      gap by the original write-fault harness.
     """
 
     kind: str
     epoch: Optional[int] = None  # step faults: epoch to fire in (None = any)
     step: Optional[int] = None  # step faults: batch ordinal in the epoch
     payload: float = float("nan")
+    hang_ms: float = 0.0
     path_glob: str = "*.ckpt"
     write_index: int = 0
     keep_fraction: float = 0.5
@@ -136,6 +166,8 @@ class FaultSpec:
             raise ValueError(f"fault kind must be one of {KINDS}, got {self.kind!r}")
         if self.kind in ("poison", "drop") and self.step is None:
             raise ValueError(f"{self.kind!r} faults need an explicit step ordinal")
+        if self.kind == "hang" and self.hang_ms <= 0:
+            raise ValueError("hang faults need hang_ms > 0")
         if not 0.0 < self.keep_fraction < 1.0:
             raise ValueError(
                 f"keep_fraction must be in (0, 1), got {self.keep_fraction}"
@@ -173,15 +205,15 @@ class FaultPlan:
         return bool(self.specs)
 
     def before_step(self, epoch: int, start: int, stop: Optional[int] = None) -> None:
-        """Fire any one-shot ``raise``/``sigterm`` fault addressed to a
-        batch ordinal in ``[start, stop)`` of ``epoch`` (a superstep block
-        passes its full range: the fault lands at the block boundary, the
-        same safe point the emergency checkpoint uses)."""
+        """Fire any one-shot ``raise``/``sigterm``/``hang`` fault addressed
+        to a batch ordinal in ``[start, stop)`` of ``epoch`` (a superstep
+        block passes its full range: the fault lands at the block boundary,
+        the same safe point the emergency checkpoint uses)."""
         if not self.specs:
             return
         stop = start + 1 if stop is None else stop
         for i, spec in enumerate(self.specs):
-            if spec.kind not in ("raise", "sigterm"):
+            if spec.kind not in ("raise", "sigterm", "hang"):
                 continue
             key = ("step", i)
             if key in self._fired or not spec._matches_step(epoch, start, stop):
@@ -190,6 +222,10 @@ class FaultPlan:
             _count_fault(spec.kind)
             if spec.kind == "sigterm":
                 signal.raise_signal(signal.SIGTERM)
+            elif spec.kind == "hang":
+                import time
+
+                time.sleep(spec.hang_ms / 1e3)
             else:
                 raise InjectedFault(
                     f"injected fault at epoch {epoch}, step {spec.step}"
@@ -229,12 +265,15 @@ class FaultPlan:
     def mutate_write(self, path: str, data: bytes) -> bytes:
         """Corrupt checkpoint bytes bound for ``path`` per any matching
         one-shot write fault (counted per spec over writes whose basename
-        matches its glob)."""
+        matches its glob). ``torn-write`` is NOT handled here — it is not
+        a byte mutation but a crash inside the atomic writer, so it lives
+        in :meth:`torn_write`, consulted by ``write_checkpoint_bytes``
+        itself."""
         if not self.specs:
             return data
         name = os.path.basename(path)
         for i, spec in enumerate(self.specs):
-            if spec.kind not in _WRITE_KINDS:
+            if spec.kind not in ("truncate-write", "corrupt-write"):
                 continue
             if not fnmatch.fnmatch(name, spec.path_glob):
                 continue
@@ -253,6 +292,41 @@ class FaultPlan:
                 mutated[idx] ^= 0x01
                 data = bytes(mutated)
         return data
+
+    def torn_write(self, path: str, data: bytes, tmp: str) -> None:
+        """Crash the atomic writer between tmp write and rename.
+
+        Consulted by ``write_checkpoint_bytes`` *before* it writes the
+        tmp file: a matching one-shot ``torn-write`` spec leaves the
+        first ``keep_fraction`` of ``data`` in ``tmp`` and raises
+        :class:`InjectedFault` — the destination ``path`` is never
+        replaced, exactly what a crash between ``f.write`` and
+        ``os.replace`` leaves behind (stale-but-intact destination plus
+        a partial ``*.tmp.<pid>`` orphan). Write ordinals are counted
+        per spec over writes whose basename matches its glob, same
+        addressing as :meth:`mutate_write`.
+        """
+        if not self.specs:
+            return
+        name = os.path.basename(path)
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "torn-write":
+                continue
+            if not fnmatch.fnmatch(name, spec.path_glob):
+                continue
+            key = ("torn", i)
+            count = self._write_counts.get(key, 0)
+            self._write_counts[key] = count + 1
+            if count != spec.write_index or key in self._fired:
+                continue
+            self._fired.add(key)
+            _count_fault("torn-write")
+            with open(tmp, "wb") as f:
+                f.write(data[: max(1, int(len(data) * spec.keep_fraction))])
+            raise InjectedFault(
+                f"injected torn write: crashed before renaming {tmp} "
+                f"over {path}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -282,6 +356,14 @@ class ServeFaultSpec:
       matching checkpoint file *at rest* (one-shot per spec), before the
       hot-swap watcher reads it: the mid-watch bit-rot drill. The
       watcher must quarantine and keep serving the old params.
+
+    Promotion kind (addressed by ``dispatch`` as the 0-based ordinal of
+    promotion-gate evaluations):
+
+    - ``"promotion-raise"`` — raise :class:`InjectedFault` at gate entry
+      (one-shot): the gate's own evaluation dying mid-decision. The gate
+      must quarantine the candidate with a typed ``gate-error`` reason
+      and the engine must keep serving its current generation.
     """
 
     kind: str
@@ -301,7 +383,10 @@ class ServeFaultSpec:
             raise ValueError("dispatch-slow faults need slow_ms > 0")
         if self.kind == "dispatch-hang" and self.hang_ms <= 0:
             raise ValueError("dispatch-hang faults need hang_ms > 0")
-        if self.kind in ("dispatch-raise", "batcher-die") and self.dispatch is None:
+        if (
+            self.kind in ("dispatch-raise", "batcher-die", "promotion-raise")
+            and self.dispatch is None
+        ):
             raise ValueError(
                 f"{self.kind!r} faults need an explicit dispatch ordinal"
             )
@@ -366,6 +451,26 @@ class ServeFaultPlan:
                     f"injected dispatch fault at dispatch {ordinal}"
                 )
 
+    def before_promotion(self, ordinal: int) -> None:
+        """Fire any one-shot ``promotion-raise`` fault addressed to this
+        promotion-gate evaluation ordinal (the gate catches it and
+        quarantines the candidate with a ``gate-error`` reason)."""
+        if not self.specs:
+            return
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "promotion-raise":
+                continue
+            if not spec._matches_dispatch(ordinal):
+                continue
+            key = ("promotion", i)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            _count_fault("promotion-raise")
+            raise InjectedFault(
+                f"injected promotion-gate fault at evaluation {ordinal}"
+            )
+
     def corrupt_checkpoints(self, out_dir: str) -> list:
         """Flip bytes at rest in checkpoint files matching any one-shot
         ``corrupt-checkpoint`` spec; returns the corrupted paths. Called
@@ -408,3 +513,113 @@ class ServeFaultPlan:
                 hit.append(path)
                 break
         return hit
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestFaultSpec:
+    """One deterministic source-stream trigger in an
+    :class:`IngestFaultPlan`, addressed by ``row`` — the 0-based ordinal
+    of rows the *source* offers (faulted rows still advance it, so a
+    plan reads like a script of the feed).
+
+    - ``"gap"``          — the source never delivers this row: the ring
+      sees a timestamp jump at the next arrival and must forward-fill.
+    - ``"out-of-order"`` — hold this row back and deliver it after the
+      next ``delay`` rows: a late arrival inside (or beyond) the ring's
+      reorder window.
+    - ``"duplicate"``    — deliver this row twice back to back: the
+      at-least-once transport case the ring must dedupe.
+    - ``"nonfinite"``    — overwrite the row's first cell with
+      ``payload`` (default NaN): a sensor glitch the ring must
+      quarantine instead of letting onto the device.
+    - ``"sigterm"``      — deliver SIGTERM to this process before the
+      row: the mid-ingest preemption drill (the ring must stay
+      consistent — every committed row fully written, bookkeeping
+      matching the device state).
+    """
+
+    kind: str
+    row: int
+    delay: int = 1
+    payload: float = float("nan")
+
+    def __post_init__(self):
+        if self.kind not in INGEST_KINDS:
+            raise ValueError(
+                f"ingest fault kind must be one of {INGEST_KINDS}, got "
+                f"{self.kind!r}"
+            )
+        if self.row < 0:
+            raise ValueError(f"row ordinal must be >= 0, got {self.row}")
+        if self.kind == "out-of-order" and self.delay < 1:
+            raise ValueError("out-of-order faults need delay >= 1")
+
+
+class IngestFaultPlan:
+    """Deterministic ingest-stream transformer for the live-feed drills.
+
+    Sits between the observation source and :class:`~stmgcn_tpu.data
+    .ring.SeriesRing`: :meth:`feed` takes each source row and returns
+    the rows that actually *arrive* (possibly none, possibly several,
+    possibly mutated or reordered) — the empty plan passes every row
+    through untouched, so production ingest runs exactly the drilled
+    code path. One-shot state (held back rows, which specs fired) lives
+    on the plan instance.
+    """
+
+    def __init__(self, *specs: IngestFaultSpec):
+        if len(specs) == 1 and not isinstance(specs[0], IngestFaultSpec):
+            specs = tuple(specs[0])  # accept IngestFaultPlan([spec, ...])
+        for s in specs:
+            if not isinstance(s, IngestFaultSpec):
+                raise TypeError(
+                    f"IngestFaultPlan takes IngestFaultSpecs, got "
+                    f"{type(s).__name__}"
+                )
+        self.specs: Tuple[IngestFaultSpec, ...] = tuple(specs)
+        self._seen = 0
+        #: held back out-of-order rows: [rows_remaining, ts, values]
+        self._held: list = []
+
+    @property
+    def active(self) -> bool:
+        return bool(self.specs)
+
+    def feed(self, ts, values) -> list:
+        """Transform one source row into the rows that arrive now.
+
+        Returns ``[(ts, values), ...]`` in arrival order. Held-back rows
+        release *after* the current row once their delay has elapsed, so
+        an ``out-of-order`` spec turns into a genuinely late arrival.
+        """
+        if not self.specs:
+            return [(ts, values)]
+        ordinal = self._seen
+        self._seen += 1
+        out = [(ts, values)]
+        for spec in self.specs:
+            if spec.row != ordinal:
+                continue
+            _count_fault(f"ingest-{spec.kind}")
+            if spec.kind == "gap":
+                out = []
+            elif spec.kind == "duplicate":
+                out = [(ts, values), (ts, values)]
+            elif spec.kind == "nonfinite":
+                import numpy as np
+
+                poisoned = np.array(values, copy=True)
+                poisoned.reshape(-1)[0] = spec.payload
+                out = [(ts, poisoned)]
+            elif spec.kind == "out-of-order":
+                self._held.append([spec.delay, ts, values])
+                out = []
+            elif spec.kind == "sigterm":
+                signal.raise_signal(signal.SIGTERM)
+        released = []
+        for h in self._held:
+            h[0] -= 1
+            if h[0] <= 0:
+                released.append((h[1], h[2]))
+        self._held = [h for h in self._held if h[0] > 0]
+        return out + released

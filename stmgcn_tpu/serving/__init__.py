@@ -45,6 +45,7 @@ from stmgcn_tpu.serving.engine import (
 )
 from stmgcn_tpu.serving.fleet import FleetServingEngine, fleet_bucket_fn
 from stmgcn_tpu.serving.metrics import EngineStats
+from stmgcn_tpu.serving.promotion import GateDecision, PromotionGate
 from stmgcn_tpu.serving.microbatch import MicroBatcher
 from stmgcn_tpu.serving.predict import serve_predict
 
@@ -56,8 +57,10 @@ __all__ = [
     "DispatchError",
     "EngineStats",
     "FleetServingEngine",
+    "GateDecision",
     "MicroBatcher",
     "Overloaded",
+    "PromotionGate",
     "ServingEngine",
     "ShedError",
     "fleet_bucket_fn",

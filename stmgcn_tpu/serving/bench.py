@@ -27,7 +27,11 @@ mid-soak atomic param hot-swap with per-generation bit parity, a
 distribution-drift rider (shifted soak stream vs a calibration-fitted
 baseline, generation-labeled gauges reset by the swap —
 ``record["soak"]["drift"]``), and a ``contended`` marker from
-:mod:`stmgcn_tpu.utils.hostload`. NOT imported
+:mod:`stmgcn_tpu.utils.hostload`. Soak records also carry
+``record["soak"]["continual"]``: the closed-loop continual drill
+(:func:`stmgcn_tpu.train.continual.closed_loop_smoke` — live ring
+ingest, a triggered fine-tune, one guarded promotion, one poisoned
+candidate rejected as ``nonfinite`` while serving continues). NOT imported
 by ``stmgcn_tpu.serving.__init__`` — the throwaway-checkpoint trainer
 pulls the full stack, and the serving package must stay lean for
 ``stmgcn_tpu.export``.
@@ -862,6 +866,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     max_delay_ms=args.max_delay_ms,
                     soak_seconds=args.soak_seconds,
                     overload=args.soak_overload,
+                )
+                sp.end()
+                # the continual-loop drill rides every soak: live ingest
+                # into the device ring, a drift-triggered fine-tune, one
+                # guarded promotion, and one poisoned candidate rejected
+                # at the gate — all while the engine keeps answering
+                sp = _phase("bench.continual")
+                from stmgcn_tpu.train.continual import closed_loop_smoke
+
+                record["soak"]["continual"] = closed_loop_smoke(
+                    os.path.join(tmp, "continual")
                 )
                 sp.end()
         record["captured_at"] = time.strftime(

@@ -1,0 +1,242 @@
+"""Guarded checkpoint promotion: the serving end of the continual loop.
+
+A continual learner that can promote a NaN checkpoint into the live
+engine is worse than no learner at all — the failure mode of an
+unattended loop is not a crashed daemon (bounded restarts cover that)
+but a *successfully written* bad candidate. :class:`PromotionGate` is
+the one door between the fine-tune daemon and the serving path: a
+candidate checkpoint reaches ``ServingEngine.swap_params`` only after
+passing, in order,
+
+1. **integrity** — the file CRC/structure-verifies (a corrupt candidate
+   write, torn or bit-flipped, is caught here, not by the watcher);
+2. **nonfinite** — zero nonfinite grad/loss observations in the
+   fine-tune health stream;
+3. **grad-norm band** — the fine-tune's peak gradient norm within the
+   configured bound;
+4. **update-ratio band** — the peak ‖Δparam‖/‖param‖ within bound (an
+   optimizer overwriting the model is drift, not learning);
+5. **held-out eval** — candidate loss on the freshest held-out targets
+   no worse than the live generation's by more than the configured
+   relative margin.
+
+Rejected candidates are quarantined in place as
+``<name>.rejected-<reason>`` with a typed :class:`GateDecision`, and
+the engine keeps serving the last good generation indefinitely —
+degradation, not failure. Accepted candidates are rotated into the
+watch directory (``latest.ckpt``) and applied through the existing
+``CheckpointWatcher.poll()`` → atomic ``swap_params(...,
+health_baseline=...)`` path, so promotion exercises exactly the
+hot-swap machinery production uses.
+
+Promotion-stage fault drills: the engine's
+:class:`~stmgcn_tpu.resilience.ServeFaultPlan` gets its
+``promotion-raise`` shot at the top of each gate evaluation; an
+injected gate crash quarantines the candidate with reason
+``"gate-error"`` rather than touching the serving path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from stmgcn_tpu.obs import trace as obs_trace
+from stmgcn_tpu.obs.registry import REGISTRY
+
+__all__ = ["GateDecision", "PromotionGate"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GateDecision:
+    """Outcome of one gate evaluation. ``reason`` is ``"promoted"`` on
+    acceptance, else the typed rejection: ``"corrupt"``,
+    ``"nonfinite"``, ``"grad-norm"``, ``"update-ratio"``,
+    ``"eval-regression"``, ``"swap-failed"``, or ``"gate-error"``
+    (injected/unexpected gate crash). ``path`` is where the candidate
+    ended up — the live ``latest.ckpt`` or its quarantine name."""
+
+    accepted: bool
+    reason: str
+    ordinal: int
+    path: str
+    generation: int
+    checks: dict
+
+
+class PromotionGate:
+    """Evaluate candidate checkpoints and promote survivors atomically.
+
+    ``holdout_eval`` is ``callable(params) -> float`` scoring a raw
+    params pytree on the freshest held-out targets (see
+    ``stmgcn_tpu.train.continual.make_holdout_eval``); with it,
+    ``live_params`` must carry the currently-serving raw params so the
+    candidate has a baseline to beat. Without either, the eval check is
+    skipped (the numeric checks still gate).
+    """
+
+    def __init__(self, engine, out_dir: str, *,
+                 grad_norm_max: float = 1e3,
+                 update_ratio_max: float = 0.5,
+                 eval_margin: float = 0.05,
+                 holdout_eval: Optional[Callable] = None,
+                 live_params=None,
+                 log=None, registry=None):
+        self._engine = engine
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.grad_norm_max = float(grad_norm_max)
+        self.update_ratio_max = float(update_ratio_max)
+        self.eval_margin = float(eval_margin)
+        self.holdout_eval = holdout_eval
+        self._live_params = (
+            None if live_params is None
+            else jax.tree.map(np.asarray, live_params)
+        )
+        self._log = log if log is not None else (lambda msg: None)
+        self._reg = REGISTRY if registry is None else registry
+        # promotion rides the production hot-swap path: a passive
+        # watcher the gate polls after rotating a survivor in
+        self.watcher = engine.watch_checkpoints(out_dir)
+        self.ordinal = 0
+        self.promotions = 0
+        self.rejections = 0
+        self.decisions: list[GateDecision] = []
+
+    @classmethod
+    def from_config(cls, engine, out_dir: str, config, **kwargs) -> "PromotionGate":
+        """Build with the bands of a :class:`~stmgcn_tpu.config
+        .ContinualConfig`."""
+        return cls(
+            engine, out_dir,
+            grad_norm_max=config.promote_grad_norm_max,
+            update_ratio_max=config.promote_update_ratio_max,
+            eval_margin=config.promote_eval_margin,
+            **kwargs,
+        )
+
+    # ------------------------------------------------------------------
+
+    def consider(self, candidate_path: str, health: dict) -> GateDecision:
+        """Run the full gate on one candidate; promote or quarantine.
+
+        ``health`` is the fine-tune's aggregated health summary
+        (``nonfinite``, ``grad_norm_max``, ``update_ratio_max`` — what
+        ``ContinualTrainer.finetune`` returns). Never raises on a bad
+        candidate: every failure becomes a typed rejection and the
+        engine keeps its current generation.
+        """
+        from stmgcn_tpu.resilience.faults import InjectedFault
+
+        t0 = time.perf_counter()
+        ordinal = self.ordinal
+        self.ordinal += 1
+        checks: dict = {}
+        try:
+            reason = self._evaluate(candidate_path, health, ordinal, checks)
+        except InjectedFault as e:
+            reason = "gate-error"
+            checks["error"] = str(e)
+        if reason is None:
+            decision = self._promote(candidate_path, ordinal, checks)
+        else:
+            decision = self._reject(candidate_path, ordinal, reason, checks)
+        t1 = time.perf_counter()
+        self._reg.histogram("promotion.gate_ms").add((t1 - t0) * 1e3)
+        trc = obs_trace.active_tracer()
+        if trc is not None:
+            trc.record_span("promotion.gate", t0, t1, {
+                "ordinal": ordinal, "accepted": decision.accepted,
+                "reason": decision.reason,
+            })
+        self.decisions.append(decision)
+        return decision
+
+    def _evaluate(self, path: str, health: dict, ordinal: int,
+                  checks: dict) -> Optional[str]:
+        """The check chain; returns the rejection reason or None."""
+        from stmgcn_tpu.train.checkpoint import load_checkpoint, verify_checkpoint
+
+        plan = getattr(self._engine, "_fault_plan", None)
+        if plan is not None:
+            plan.before_promotion(ordinal)
+        try:
+            verify_checkpoint(path)
+        except (ValueError, OSError) as e:
+            checks["corrupt"] = str(e)
+            return "corrupt"
+        nonfinite = int(health.get("nonfinite", 0))
+        checks["nonfinite"] = nonfinite
+        if nonfinite:
+            return "nonfinite"
+        grad_norm = float(health.get("grad_norm_max", 0.0))
+        checks["grad_norm"] = (grad_norm, self.grad_norm_max)
+        # NaN-safe: "within band" must hold, not "not above band"
+        if not grad_norm <= self.grad_norm_max:
+            return "grad-norm"
+        ratio = float(health.get("update_ratio_max", 0.0))
+        checks["update_ratio"] = (ratio, self.update_ratio_max)
+        if not ratio <= self.update_ratio_max:
+            return "update-ratio"
+        if self.holdout_eval is not None and self._live_params is not None:
+            _, params, _ = load_checkpoint(
+                path, self._engine._params_template, None,
+                load_opt_state=False,
+            )
+            cand = float(self.holdout_eval(params))
+            live = float(self.holdout_eval(self._live_params))
+            bound = live * (1.0 + self.eval_margin)
+            checks["eval"] = (cand, live, bound)
+            if not cand <= bound:
+                return "eval-regression"
+            checks["_params"] = params  # reuse for live baseline update
+        return None
+
+    def _promote(self, path: str, ordinal: int, checks: dict) -> GateDecision:
+        latest = os.path.join(self.out_dir, "latest.ckpt")
+        prev = os.path.join(self.out_dir, "latest.prev.ckpt")
+        try:
+            os.replace(latest, prev)
+        except OSError:  # first promotion: nothing to rotate
+            pass
+        os.replace(path, latest)
+        params = checks.pop("_params", None)
+        if not self.watcher.poll():
+            # the rotated-in file did not swap (e.g. raced quarantine) —
+            # the engine is untouched, so report it as a rejection
+            self._count_reject("swap-failed")
+            self._log(f"promotion {ordinal}: rotated {latest} but the "
+                      "watcher applied no swap")
+            return GateDecision(False, "swap-failed", ordinal, latest,
+                                self._engine.generation, checks)
+        if params is not None:
+            self._live_params = jax.tree.map(np.asarray, params)
+        self.promotions += 1
+        self._reg.counter("continual.promotions").inc()
+        self._log(f"promotion {ordinal}: {latest} -> generation "
+                  f"{self._engine.generation}")
+        return GateDecision(True, "promoted", ordinal, latest,
+                            self._engine.generation, checks)
+
+    def _reject(self, path: str, ordinal: int, reason: str,
+                checks: dict) -> GateDecision:
+        checks.pop("_params", None)
+        quarantined = f"{path}.rejected-{reason}"
+        try:
+            os.replace(path, quarantined)
+        except OSError:
+            quarantined = path  # nothing to move (already gone/torn)
+        self._count_reject(reason)
+        self._log(f"promotion {ordinal}: rejected ({reason}) — quarantined "
+                  f"as {quarantined}")
+        return GateDecision(False, reason, ordinal, quarantined,
+                            self._engine.generation, checks)
+
+    def _count_reject(self, reason: str) -> None:
+        self.rejections += 1
+        self._reg.counter("continual.rejections", {"reason": reason}).inc()

@@ -15,6 +15,12 @@ from stmgcn_tpu.train.checkpoint import (
     save_checkpoint,
     verify_checkpoint,
 )
+from stmgcn_tpu.train.continual import (
+    ContinualDaemon,
+    ContinualTrainer,
+    closed_loop_smoke,
+    make_holdout_eval,
+)
 from stmgcn_tpu.train.metrics import MAE, MAPE, MSE, PCC, RMSE, regression_report
 from stmgcn_tpu.train.step import (
     FleetSuperstepFns,
@@ -33,6 +39,8 @@ from stmgcn_tpu.train.trainer import CitySupports, Trainer
 
 __all__ = [
     "CitySupports",
+    "ContinualDaemon",
+    "ContinualTrainer",
     "CorruptCheckpointError",
     "FleetSuperstepFns",
     "MAE",
@@ -44,7 +52,9 @@ __all__ = [
     "StepFns",
     "SuperstepFns",
     "Trainer",
+    "closed_loop_smoke",
     "gather_window_batch",
+    "make_holdout_eval",
     "health_group_names",
     "load_checkpoint",
     "load_latest_verified",

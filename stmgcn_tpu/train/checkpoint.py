@@ -87,18 +87,34 @@ def serialize_checkpoint(params: Any, opt_state: Any, meta: dict) -> bytes:
     return b"".join(out)
 
 
-def write_checkpoint_bytes(path: str, data: bytes) -> None:
+def write_checkpoint_bytes(path: str, data: bytes, fault_plan=None) -> None:
     """Atomically write a serialized checkpoint (temp file + ``os.replace``
-    so a preemption mid-write never corrupts the previous checkpoint)."""
+    so a preemption mid-write never corrupts the previous checkpoint).
+
+    ``fault_plan`` threads a :class:`~stmgcn_tpu.resilience.FaultPlan`
+    through for the torn-write drill — a crash *between* the tmp write
+    and the rename, the one case the atomic dance cannot cover from
+    inside the process (the plan leaves a partial ``*.tmp.<pid>`` orphan
+    and raises without ever touching ``path``). Empty/absent plan is the
+    production no-op.
+    """
     tmp = f"{path}.tmp.{os.getpid()}"
+    if fault_plan is not None:
+        fault_plan.torn_write(path, data, tmp)
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)
 
 
-def save_checkpoint(path: str, params: Any, opt_state: Any, meta: dict) -> None:
-    """Atomically write ``params``/``opt_state``/``meta`` to ``path``."""
-    write_checkpoint_bytes(path, serialize_checkpoint(params, opt_state, meta))
+def save_checkpoint(path: str, params: Any, opt_state: Any, meta: dict, *,
+                    fault_plan=None) -> None:
+    """Atomically write ``params``/``opt_state``/``meta`` to ``path``
+    (``fault_plan`` reaches both the byte-mutation and torn-write write
+    faults — the continual daemon's candidate writes go through here)."""
+    data = serialize_checkpoint(params, opt_state, meta)
+    if fault_plan is not None:
+        data = fault_plan.mutate_write(path, data)
+    write_checkpoint_bytes(path, data, fault_plan)
 
 
 def _read_exact(f, n: int, path: str, what: str) -> bytes:

@@ -1,0 +1,515 @@
+"""Continual fine-tuning behind the serving path: the loop's train side.
+
+The closed loop is: live rows land in a device-resident
+:class:`~stmgcn_tpu.data.SeriesRing`; PR 11's drift gauges (or a
+wall-clock cadence) trip a retrain; :class:`ContinualTrainer` fine-tunes
+on the freshest ring contents through the existing fused series
+superstep and writes a CRC-verified candidate checkpoint;
+:class:`~stmgcn_tpu.serving.PromotionGate` either promotes it through
+the atomic hot-swap path or quarantines it with a typed reason.
+
+The supervision contract — the part that makes the loop safe to leave
+unattended — is isolation by construction:
+
+- the trainer keeps its committed state as **host** numpy pytrees
+  (the superstep donates its device operands, so device state cannot be
+  the source of truth across a crashed step); a fine-tune produces
+  *pending* state that becomes committed only after the gate accepts
+  its checkpoint, and is discarded wholesale on rejection or crash;
+- :class:`ContinualDaemon` supervises ``finetune()`` with exponential
+  backoff + deterministic jitter under a bounded restart budget; when
+  the budget is spent the daemon marks itself ``down`` and stops —
+  serving continues on the last promoted generation either way;
+- daemon fault drills ride the training-side
+  :class:`~stmgcn_tpu.resilience.FaultPlan`: ``raise``/``hang`` fire at
+  the fine-tune's step boundary, ``poison`` lands NaN in one step's
+  loss mask (the gate then rejects the candidate as ``nonfinite``), and
+  the write kinds (``corrupt-write``/``torn-write``) corrupt or tear
+  the candidate checkpoint itself.
+
+``closed_loop_smoke`` packs the whole loop — ingest, drift/cadence
+trigger, fine-tune, one clean promotion, one poisoned rejection, live
+serving throughout — into a CPU-sized drill for ``scripts/lint_gate.sh``
+and the soak bench.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stmgcn_tpu.obs.registry import REGISTRY
+from stmgcn_tpu.train.step import (
+    gather_window_batch,
+    make_series_superstep_fns,
+    make_step_fns,
+)
+
+__all__ = [
+    "ContinualDaemon",
+    "ContinualTrainer",
+    "closed_loop_smoke",
+    "make_holdout_eval",
+]
+
+
+class ContinualTrainer:
+    """Fine-tune on the freshest ring contents; emit candidate checkpoints.
+
+    Never mutates its committed state on its own: ``finetune()`` stages
+    the post-step params/opt-state as *pending* and the caller promotes
+    them with :meth:`commit` only after the gate accepts the candidate
+    (or drops them with :meth:`discard`). Committed state lives as host
+    numpy — the fused superstep donates its device params/opt-state
+    buffers, so a fresh device copy is staged per fine-tune and a crash
+    mid-step can never leave half-updated truth behind.
+    """
+
+    def __init__(self, model, optimizer, supports, ring, spec, config,
+                 out_dir: str, *, params, opt_state=None, loss: str = "mse",
+                 holdout: int = 4, fault_plan=None, health_baseline=None,
+                 meta: Optional[dict] = None, registry=None, log=None):
+        self.ring = ring
+        self.spec = spec
+        self.config = config
+        self.out_dir = out_dir
+        self.candidate_dir = os.path.join(out_dir, "candidates")
+        os.makedirs(self.candidate_dir, exist_ok=True)
+        self.holdout = int(holdout)
+        self.fault_plan = fault_plan
+        self.health_baseline = health_baseline
+        self.meta = dict(meta) if meta else {}
+        self._supports = jnp.asarray(supports) if not isinstance(
+            supports, (list, tuple, dict)) else supports
+        self._offsets = jnp.asarray(spec.offsets, jnp.int32)
+        self._fns = make_series_superstep_fns(
+            model, optimizer, loss=loss, horizon=spec.horizon, health=True,
+        )
+        # committed truth is HOST numpy (donation-safe); opt_state defaults
+        # to a fresh optimizer state over the serving params
+        self._params = jax.tree.map(np.asarray, params)
+        self._opt_state = jax.tree.map(
+            np.asarray,
+            optimizer.init(params) if opt_state is None else opt_state,
+        )
+        self._pending: Optional[Tuple] = None
+        self.ordinal = 0
+        self._reg = REGISTRY if registry is None else registry
+        self._log = log if log is not None else (lambda msg: None)
+
+    @property
+    def params(self):
+        """The committed (last accepted) host params pytree."""
+        return self._params
+
+    def _train_idx_block(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(targets, idx_block): the freshest S*B training samples.
+
+        ``targets`` are ring-local target timesteps with the last
+        ``holdout`` excluded (the gate's held-out eval scores those);
+        ``idx_block`` is ``(S, B)`` int32 into ``targets``, taking the
+        freshest samples and wrapping when the ring holds fewer than a
+        full block.
+        """
+        cfg = self.config
+        last = cfg.finetune_window if cfg.finetune_window else None
+        targets = self.ring.target_indices(self.spec, last=last)
+        if self.holdout and len(targets) > self.holdout:
+            targets = targets[: -self.holdout]
+        n = len(targets)
+        s, b = cfg.finetune_steps, cfg.finetune_batch
+        flat = (np.arange(s * b) + max(0, n - s * b)) % n
+        return targets, flat.reshape(s, b).astype(np.int32)
+
+    def finetune(self) -> Tuple[str, dict]:
+        """One supervised fine-tune: S fused steps on the freshest ring
+        rows, candidate checkpoint written, health summary returned.
+
+        Returns ``(candidate_path, health)`` where ``health`` is the
+        aggregate the promotion gate consumes: ``nonfinite`` (total
+        nonfinite grad/loss observations), ``grad_norm_max``,
+        ``update_ratio_max``, ``loss_last``. Raises whatever the fault
+        plan or the step raises — supervision (backoff, restart budget)
+        is the daemon's job, not this method's.
+        """
+        ordinal = self.ordinal
+        self.ordinal += 1
+        cfg = self.config
+        s, b = cfg.finetune_steps, cfg.finetune_batch
+        targets, idx_block = self._train_idx_block()
+        mask_block = np.ones((s, b), np.float32)
+        plan = self.fault_plan
+        if plan is not None:
+            plan.before_step(ordinal, 0, s)  # raise/sigterm/hang drills
+            for step in range(s):
+                payload = plan.poison_value(ordinal, step)
+                if payload is not None:
+                    mask_block[step, 0] = payload
+        series = self.ring.series()
+        params, opt_state, losses, stats = self._fns.train_superstep(
+            jax.tree.map(jnp.asarray, self._params),
+            jax.tree.map(jnp.asarray, self._opt_state),
+            self._supports,
+            series,
+            jnp.asarray(targets, jnp.int32),
+            self._offsets,
+            jnp.asarray(idx_block),
+            jnp.asarray(mask_block),
+        )
+        health = {
+            "nonfinite": int(
+                np.asarray(stats["nonfinite_grads"]).sum()
+                + np.asarray(stats["nonfinite_loss"]).sum()
+            ),
+            "grad_norm_max": float(np.max(np.asarray(stats["grad_norm"]))),
+            "update_ratio_max": float(
+                np.max(np.asarray(stats["update_ratio"]))
+            ),
+            "loss_last": float(np.asarray(losses)[-1]),
+        }
+        self._pending = (
+            jax.tree.map(np.asarray, params),
+            jax.tree.map(np.asarray, opt_state),
+        )
+        path = os.path.join(
+            self.candidate_dir, f"candidate-{ordinal:04d}.ckpt"
+        )
+        meta = dict(self.meta)
+        meta.update({
+            "kind": "continual",
+            "ordinal": ordinal,
+            "steps": s,
+            "batch": b,
+            "next_ts": int(self.ring.next_ts),
+            "health": {k: v for k, v in health.items()
+                       if v == v},  # keep the meta JSON NaN-free
+        })
+        if self.health_baseline is not None:
+            meta["health_baseline"] = self.health_baseline
+        from stmgcn_tpu.train.checkpoint import save_checkpoint
+
+        save_checkpoint(path, self._pending[0], self._pending[1], meta,
+                        fault_plan=plan)
+        self._reg.counter("continual.retrains").inc()
+        self._log(f"fine-tune {ordinal}: loss {health['loss_last']:.5f}, "
+                  f"candidate {path}")
+        return path, health
+
+    def commit(self) -> None:
+        """Adopt the pending fine-tune as committed truth (gate accepted)."""
+        if self._pending is not None:
+            self._params, self._opt_state = self._pending
+            self._pending = None
+
+    def discard(self) -> None:
+        """Drop the pending fine-tune (gate rejected, or the step crashed).
+        The next fine-tune restarts from the committed state."""
+        self._pending = None
+
+
+def make_holdout_eval(model, supports, ring, spec, *, holdout: int = 4,
+                      loss: str = "mse") -> Callable:
+    """``callable(params) -> float``: loss on the ring's freshest targets.
+
+    The gate calls this twice per candidate (candidate params vs the
+    live baseline) against the SAME held-out rows — the freshest
+    ``holdout`` targets, which :class:`ContinualTrainer` excludes from
+    its training block. Re-reads the ring per call, so the comparison
+    always scores current traffic; shapes are constant (``holdout``
+    fixed), so the underlying jitted eval compiles once.
+    """
+    import optax
+
+    fns = make_step_fns(model, optax.sgd(0.0), loss=loss)
+    supports = jnp.asarray(supports)
+    mask = jnp.ones((holdout,), jnp.float32)
+
+    def evaluate(params) -> float:
+        targets = ring.target_indices(spec)[-holdout:]
+        series = ring.series()
+        x, y = gather_window_batch(
+            series,
+            jnp.asarray(targets, jnp.int32),
+            jnp.asarray(spec.offsets, jnp.int32),
+            jnp.arange(holdout, dtype=jnp.int32),
+            spec.horizon,
+        )
+        loss_val, _ = fns.eval_step(
+            jax.tree.map(jnp.asarray, params), supports, x, y, mask
+        )
+        return float(loss_val)
+
+    return evaluate
+
+
+class ContinualDaemon:
+    """Supervise the fine-tune → gate loop; never endanger serving.
+
+    Synchronous core (``should_retrain``/``poll``/``retrain`` — what the
+    tests drive deterministically) plus an optional background thread
+    (``start``/``stop``) that mirrors the checkpoint watcher's
+    discipline: stop event, daemon thread, bounded join.
+
+    A fine-tune that raises is retried with exponential backoff and
+    deterministic jitter up to ``config.max_restarts`` times; exhausting
+    the budget marks the daemon ``down`` (gauge ``continual.daemon_up``
+    drops to 0) and retires it. In every failure mode the serving engine
+    keeps answering from its last promoted generation.
+    """
+
+    JOIN_TIMEOUT_S = 5.0
+
+    def __init__(self, trainer: ContinualTrainer, gate, *, config,
+                 time_fn=time.monotonic, sleep_fn=time.sleep,
+                 rng_seed: int = 0, registry=None, log=None):
+        self.trainer = trainer
+        self.gate = gate
+        self.config = config
+        self._time = time_fn
+        self._sleep = sleep_fn
+        self._rng = random.Random(rng_seed)
+        self._reg = REGISTRY if registry is None else registry
+        self._log = log if log is not None else (lambda msg: None)
+        self._last_retrain = time_fn()
+        self.down = False
+        self.restarts = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reg.gauge("continual.daemon_up").set(1)
+
+    # -- trigger ---------------------------------------------------------
+
+    def should_retrain(self) -> Optional[str]:
+        """``"drift"`` | ``"cadence"`` | None — why to retrain now.
+
+        Drift wins: any city/phase gauge in the engine's live drift
+        snapshot over ``drift_z_max``/``drift_psi`` fires regardless of
+        cadence. Cadence fires when ``cadence_s > 0`` and that much wall
+        clock has passed since the last completed retrain.
+        """
+        if self.down:
+            return None
+        snap = self.gate._engine.drift_snapshot()
+        if snap is not None:
+            cfg = self.config
+            for phases in snap.get("cities", {}).values():
+                for gauges in phases.values():
+                    z = float(gauges.get("z_max", 0.0))
+                    psi = float(gauges.get("psi", 0.0))
+                    if z > cfg.drift_z_max or psi > cfg.drift_psi:
+                        return "drift"
+        if self.config.cadence_s > 0:
+            if self._time() - self._last_retrain >= self.config.cadence_s:
+                return "cadence"
+        return None
+
+    def poll(self):
+        """Check the trigger; run one retrain cycle if it fires.
+        Returns the gate's decision, or None when idle/down/exhausted."""
+        reason = self.should_retrain()
+        if reason is None:
+            return None
+        return self.retrain(reason)
+
+    def retrain(self, reason: str):
+        """One supervised fine-tune → gate cycle.
+
+        Crashes inside ``finetune()`` are retried under the restart
+        budget with backoff ``min(backoff_s * 2**k, backoff_max_s)``
+        plus up to 10% deterministic jitter; the budget spent, the
+        daemon goes ``down`` and returns None. A completed fine-tune
+        always reaches the gate, and the gate's verdict decides whether
+        the trainer commits or discards the pending state.
+        """
+        cfg = self.config
+        attempts = 0
+        while True:
+            try:
+                path, health = self.trainer.finetune()
+                break
+            except Exception as e:  # Preempted is BaseException: passes
+                self.trainer.discard()
+                attempts += 1
+                self.restarts += 1
+                if attempts > cfg.max_restarts:
+                    self.down = True
+                    self._reg.gauge("continual.daemon_up").set(0)
+                    self._log(f"retrain ({reason}) abandoned after "
+                              f"{attempts} attempts: {e!r} — daemon down, "
+                              "serving continues on the live generation")
+                    return None
+                delay = min(cfg.backoff_s * (2.0 ** (attempts - 1)),
+                            cfg.backoff_max_s)
+                delay *= 1.0 + 0.1 * self._rng.random()
+                self._log(f"retrain ({reason}) attempt {attempts} failed: "
+                          f"{e!r}; backing off {delay * 1e3:.0f} ms")
+                self._sleep(delay)
+        decision = self.gate.consider(path, health)
+        if decision.accepted:
+            self.trainer.commit()
+        else:
+            self.trainer.discard()
+        self._last_retrain = self._time()
+        self._log(f"retrain ({reason}) -> {decision.reason} "
+                  f"(generation {decision.generation})")
+        return decision
+
+    # -- background supervision ------------------------------------------
+
+    def start(self, poll_s: float = 1.0) -> "ContinualDaemon":
+        """Poll the trigger on a daemon thread until :meth:`stop`."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(poll_s):
+                try:
+                    self.poll()
+                except Exception as e:  # the daemon never kills serving
+                    self._log(f"continual daemon poll error: {e!r}")
+                if self.down:
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="continual-daemon", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: Optional[float] = None) -> bool:
+        """Signal the loop and join it, bounded (thread is daemon — a
+        straggler cannot hold the process open). True when it exited."""
+        self._stop.set()
+        t = self._thread
+        if t is None:
+            return True
+        t.join(self.JOIN_TIMEOUT_S if timeout_s is None else timeout_s)
+        if t.is_alive():
+            return False
+        self._thread = None
+        return True
+
+
+def closed_loop_smoke(out_dir: str, *, poison: bool = True,
+                      seed: int = 0) -> dict:
+    """The whole closed loop, CPU-sized: the lint-gate/soak drill.
+
+    Builds a tiny serial-only model + ring, serves live throughout, and
+    runs two retrain cycles: one clean (promoted through the gate into
+    the engine) and — with ``poison=True`` — one with a NaN poisoned
+    into the fine-tune's loss mask (rejected as ``nonfinite``; serving
+    stays on the promoted generation). Returns the verdict counts the
+    gate script asserts on: ``promotions``, ``rejections``,
+    ``nonfinite`` (of the *clean* fine-tune), ``rejection_reason``,
+    ``generation``, plus ingest/serving evidence.
+    """
+    import optax
+
+    from stmgcn_tpu.config import ContinualConfig, ServingConfig, preset
+    from stmgcn_tpu.data import (
+        DemandDataset,
+        MinMaxNormalizer,
+        SeriesRing,
+        WindowSpec,
+        synthetic_dataset,
+    )
+    from stmgcn_tpu.experiment import build_model
+    from stmgcn_tpu.inference import Forecaster
+    from stmgcn_tpu.ops import SupportConfig
+    from stmgcn_tpu.resilience import FaultPlan, FaultSpec
+    from stmgcn_tpu.serving import PromotionGate
+
+    cfg = preset("smoke")
+    cfg.data.override(rows=2, n_timesteps=64,
+                      serial_len=3, daily_len=0, weekly_len=0)
+    spec = WindowSpec(3, 0, 0, 24 // cfg.data.dt, cfg.data.horizon)
+    data = synthetic_dataset(rows=2, n_timesteps=64, seed=seed)
+    ds = DemandDataset(data, spec)
+    supports = np.asarray(
+        SupportConfig(cfg.model.kernel_type, cfg.model.K).build_all(
+            ds.adjs.values()
+        ),
+        np.float32,
+    )[: cfg.model.m_graphs]
+    model = build_model(cfg, ds.n_feats)
+    x0 = jnp.zeros((1, spec.seq_len, ds.n_nodes, ds.n_feats), jnp.float32)
+    params = model.init(jax.random.key(seed), jnp.asarray(supports), x0)
+    norm = MinMaxNormalizer.fit(np.asarray(data.demand))
+    normalized = np.asarray(norm.transform(np.asarray(data.demand)),
+                            np.float32)
+
+    warm = 48  # pre-filled history; the rest arrives live below
+    ring = SeriesRing.from_series(normalized[:warm], capacity=64,
+                                  reorder_window=2)
+    fc = Forecaster(model, params, norm, cfg,
+                    {"input_dim": ds.n_feats, "n_nodes": ds.n_nodes})
+    engine = fc.serving_engine(
+        supports, config=ServingConfig(buckets=(1, 2), max_batch=2,
+                                       max_delay_ms=2.0),
+    )
+    ccfg = ContinualConfig(
+        enabled=True, ring_capacity=64, reorder_window=2,
+        finetune_steps=2, finetune_batch=2, max_restarts=1,
+        backoff_s=0.01, backoff_max_s=0.02,
+        promote_grad_norm_max=1e6, promote_update_ratio_max=100.0,
+        promote_eval_margin=10.0,
+    )
+    # the second fine-tune (ordinal 1) gets NaN in step 0's loss mask
+    plan = FaultPlan(FaultSpec(kind="poison", epoch=1, step=0)) \
+        if poison else FaultPlan()
+    trainer = ContinualTrainer(
+        model, optax.adam(1e-3), supports, ring, spec, ccfg, out_dir,
+        params=params, holdout=2, fault_plan=plan,
+    )
+    gate = PromotionGate.from_config(
+        engine, out_dir, ccfg,
+        holdout_eval=make_holdout_eval(model, supports, ring, spec,
+                                       holdout=2),
+        live_params=params,
+    )
+    daemon = ContinualDaemon(trainer, gate, config=ccfg)
+
+    rng = np.random.default_rng(seed)
+
+    def serve() -> np.ndarray:
+        hist = rng.uniform(
+            0, 50, (1, spec.seq_len, ds.n_nodes, ds.n_feats)
+        ).astype(np.float32)
+        return np.asarray(engine.predict(hist))
+
+    try:
+        predictions = 1
+        serve()  # generation 0 answers before any retrain
+        for ts in range(warm, 56):  # live rows land mid-loop
+            ring.ingest(ts, normalized[ts])
+        clean = daemon.retrain("drift")
+        predictions += 1
+        serve()  # the promoted generation answers
+        for ts in range(56, 64):
+            ring.ingest(ts, normalized[ts])
+        second = daemon.retrain("cadence")
+        predictions += 1
+        serve()  # rejection left serving untouched
+        return {
+            "schema_version": 1,
+            "promotions": gate.promotions,
+            "rejections": gate.rejections,
+            "nonfinite": int(clean.checks.get("nonfinite", -1))
+            if clean is not None else -1,
+            "rejection_reason": None if second is None else second.reason,
+            "generation": engine.generation,
+            "rows_ingested": int(ring.rows),
+            "ring_len": len(ring),
+            "predictions": predictions,
+            "daemon_down": daemon.down,
+        }
+    finally:
+        engine.close()

@@ -750,7 +750,7 @@ class Trainer:
     def _write(self, path: str, data: bytes) -> None:
         data = self.fault_plan.mutate_write(path, data)
         if not self.async_checkpoint:
-            write_checkpoint_bytes(path, data)
+            write_checkpoint_bytes(path, data, self.fault_plan)
             return
         import queue
 
@@ -768,7 +768,7 @@ class Trainer:
                     op, path, payload = job
                     try:
                         if op == "write":
-                            write_checkpoint_bytes(path, payload)
+                            write_checkpoint_bytes(path, payload, self.fault_plan)
                         elif op == "rotate":  # latest -> latest.prev
                             try:
                                 os.replace(path, payload)

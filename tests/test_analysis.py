@@ -980,6 +980,135 @@ class TestHealthOverheadRule:
         assert check_health_overhead([("none", object())]) == []
 
 
+class TestContinualConfigRule:
+    """Pass 2j: the continual-config contract — closed-loop knobs that
+    turn an unattended learner into an outage. Boundaries pinned like
+    the other contract rules: the budget/window/duty limits themselves
+    are clean, one past them is flagged; trigger/retry/gate checks only
+    gate once the loop is enabled."""
+
+    @staticmethod
+    def _cfg(drift_health=False, **kw):
+        from stmgcn_tpu.config import ContinualConfig, preset
+
+        cfg = preset("smoke")
+        cfg.continual = ContinualConfig(**kw)
+        if drift_health:
+            cfg.health.drift = True
+            cfg.health.baseline = True
+        return cfg
+
+    def test_rule_registered_as_error(self):
+        assert RULES["continual-config"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        assert check_continual_config() == []
+
+    def test_ring_window_boundary(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        # smoke's window spec (3,1,1,24) needs burn_in+horizon = 169 rows
+        f = check_continual_config([("bad", self._cfg(ring_capacity=168))])
+        assert f and all(x.rule == "continual-config" for x in f)
+        assert all(x.severity == "error" for x in f)
+        assert f[0].path == "<contract:continual:bad>"
+        assert any("training window" in x.message for x in f)
+        assert check_continual_config(
+            [("ok", self._cfg(ring_capacity=169))]
+        ) == []
+
+    def test_resident_budget_boundary(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        # smoke: 10x10 grid, 1 channel, fp32 -> 400 B/row
+        budget = 400 * 1000
+        assert check_continual_config(
+            [("ok", self._cfg(ring_capacity=1000))], budget_bytes=budget
+        ) == []
+        f = check_continual_config(
+            [("bad", self._cfg(ring_capacity=1001))], budget_bytes=budget
+        )
+        assert any("resident budget" in x.message for x in f)
+
+    def test_reorder_window_must_be_resident(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        f = check_continual_config(
+            [("bad", self._cfg(ring_capacity=200, reorder_window=200))]
+        )
+        assert any("reorder_window" in x.message for x in f)
+        assert check_continual_config(
+            [("ok", self._cfg(ring_capacity=200, reorder_window=199))]
+        ) == []
+
+    def test_duty_cycle_boundary(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        # 8 supersteps x 625 ms every 10 s = duty 0.5 == max_duty: clean
+        ok = self._cfg(enabled=True, cadence_s=10.0, superstep_ms=625.0)
+        assert check_continual_config([("ok", ok)]) == []
+        bad = self._cfg(enabled=True, cadence_s=10.0, superstep_ms=626.0)
+        f = check_continual_config([("bad", bad)])
+        assert any("starves serving" in x.message for x in f)
+        # unmeasured superstep time: duty math is skipped, not guessed
+        un = self._cfg(enabled=True, cadence_s=0.001, superstep_ms=0.0)
+        assert check_continual_config([("ok", un)]) == []
+
+    def test_drift_trigger_requires_baseline(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        # cadence 0 = drift-only trigger; smoke's health has drift off
+        f = check_continual_config(
+            [("bad", self._cfg(enabled=True, cadence_s=0.0))]
+        )
+        assert any("never fire" in x.message for x in f)
+        assert check_continual_config(
+            [("ok", self._cfg(drift_health=True, enabled=True,
+                              cadence_s=0.0))]
+        ) == []
+
+    def test_gate_thresholds_present_and_ordered(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        f = check_continual_config(
+            [("bad", self._cfg(enabled=True, cadence_s=60.0,
+                               promote_update_ratio_max=0.0))]
+        )
+        assert any("rejects every candidate" in x.message for x in f)
+        f = check_continual_config(
+            [("bad", self._cfg(enabled=True, cadence_s=60.0,
+                               promote_eval_margin=-0.1))]
+        )
+        assert any("promote_eval_margin" in x.message for x in f)
+        f = check_continual_config(
+            [("bad", self._cfg(enabled=True, cadence_s=60.0,
+                               backoff_s=1.0, backoff_max_s=0.5))]
+        )
+        assert any("backoff" in x.message for x in f)
+
+    def test_disabled_loop_is_dormant_config(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        # loop off: absurd trigger/retry/gate knobs are dormant, but the
+        # ring bounds still apply (a pre-filled ring exists without the
+        # daemon)
+        assert check_continual_config(
+            [("off", self._cfg(enabled=False, backoff_s=-1.0,
+                               promote_update_ratio_max=0.0))]
+        ) == []
+        f = check_continual_config(
+            [("off", self._cfg(enabled=False, ring_capacity=0))]
+        )
+        assert any("ring_capacity" in x.message for x in f)
+
+    def test_configs_without_continual_section_skipped(self):
+        from stmgcn_tpu.analysis import check_continual_config
+
+        assert check_continual_config([("none", object())]) == []
+
+
 class TestResidentMemoryRule:
     """Pass 2f: the resident-memory footprint contract (pure config math
     — the same arithmetic as DemandDataset.resident_nbytes/nbytes,
@@ -2078,3 +2207,8 @@ class TestLintGateScript:
         assert payload["health"]["nonfinite"] == 0
         assert payload["health"]["records"] > 0
         assert payload["health"]["findings"] == 0
+        # the closed-loop continual drill: one clean promotion, one
+        # poisoned rejection, zero nonfinite in the clean health stream
+        assert payload["continual"] == {
+            "exit": 0, "promotions": 1, "rejections": 1, "nonfinite": 0,
+        }

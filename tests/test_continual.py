@@ -1,0 +1,478 @@
+"""Closed-loop continual serving: fine-tune daemon, guarded promotion,
+torn-write recovery, and the end-to-end smoke drill.
+
+The loop's safety claims are pinned the same way the serving and
+resilience suites pin theirs — deterministically, through the fault
+plans, never by anecdote:
+
+- **loop-off parity** (tentpole contract): with the daemon disabled, a
+  pre-filled ring fine-tuned through :class:`ContinualTrainer` is
+  BIT-identical to the existing window-free resident path driven by
+  hand — same superstep, same gather, equality not allclose;
+- **torn-write** (satellite): a crash between tmp write and rename
+  leaves the destination untouched and a ``*.tmp.<pid>`` orphan that
+  both ``load_latest_verified`` and the hot-swap watcher ignore;
+- **gate**: every typed rejection reason has a test that drives it, and
+  a rejected candidate never moves the engine's generation;
+- **daemon**: injected fine-tune crashes retry under the restart budget
+  and exhaust into ``down`` — with serving untouched either way;
+- **smoke**: ``closed_loop_smoke`` (what ``scripts/lint_gate.sh``
+  asserts on) runs live ingest + one promotion + one poisoned
+  ``nonfinite`` rejection while the engine answers throughout.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from stmgcn_tpu.config import ContinualConfig, ServingConfig, preset
+from stmgcn_tpu.data import (
+    DemandDataset,
+    MinMaxNormalizer,
+    SeriesRing,
+    WindowSpec,
+    synthetic_dataset,
+)
+from stmgcn_tpu.experiment import build_model
+from stmgcn_tpu.inference import Forecaster
+from stmgcn_tpu.obs.registry import REGISTRY
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    ServeFaultPlan,
+    ServeFaultSpec,
+)
+from stmgcn_tpu.serving import PromotionGate
+from stmgcn_tpu.train import (
+    ContinualDaemon,
+    ContinualTrainer,
+    closed_loop_smoke,
+    load_latest_verified,
+    make_series_superstep_fns,
+    save_checkpoint,
+)
+
+SPEC = WindowSpec(3, 0, 0, 24, 1)  # serial-only: burn_in 3, CPU-sized
+
+CCFG = ContinualConfig(
+    enabled=True, ring_capacity=64, reorder_window=2,
+    finetune_steps=2, finetune_batch=2, max_restarts=2,
+    backoff_s=0.001, backoff_max_s=0.002,
+    promote_grad_norm_max=1e6, promote_update_ratio_max=100.0,
+    promote_eval_margin=0.05,
+)
+
+#: a clean fine-tune health summary (what the gate accepts)
+CLEAN = {"nonfinite": 0, "grad_norm_max": 1.0, "update_ratio_max": 1e-3,
+         "loss_last": 0.5}
+
+
+class _NS:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = preset("smoke")
+    cfg.data.override(rows=2, n_timesteps=64,
+                      serial_len=3, daily_len=0, weekly_len=0)
+    data = synthetic_dataset(rows=2, n_timesteps=64, seed=0)
+    ds = DemandDataset(data, SPEC)
+    supports = np.asarray(
+        SupportConfig(cfg.model.kernel_type, cfg.model.K).build_all(
+            ds.adjs.values()
+        ),
+        np.float32,
+    )[: cfg.model.m_graphs]
+    model = build_model(cfg, ds.n_feats)
+    x0 = jnp.zeros((1, SPEC.seq_len, ds.n_nodes, ds.n_feats), jnp.float32)
+    params = model.init(jax.random.key(0), jnp.asarray(supports), x0)
+    norm = MinMaxNormalizer.fit(np.asarray(data.demand))
+    series = np.asarray(norm.transform(np.asarray(data.demand)), np.float32)
+    fc = Forecaster(model, params, norm, cfg,
+                    {"input_dim": ds.n_feats, "n_nodes": ds.n_nodes})
+    return _NS(cfg=cfg, ds=ds, supports=supports, model=model,
+               params=params, series=series, fc=fc)
+
+
+@pytest.fixture(scope="module")
+def engine(setup):
+    eng = setup.fc.serving_engine(
+        setup.supports,
+        config=ServingConfig(buckets=(1, 2), max_batch=2, max_delay_ms=2.0),
+    )
+    yield eng
+    eng.close()
+
+
+def _ring(setup, rows=64):
+    return SeriesRing.from_series(setup.series[:rows], capacity=64,
+                                  reorder_window=2)
+
+
+def _trainer(setup, ring, out_dir, fault_plan=None):
+    return ContinualTrainer(
+        setup.model, optax.adam(1e-3), setup.supports, ring, SPEC, CCFG,
+        str(out_dir), params=setup.params, holdout=2, fault_plan=fault_plan,
+    )
+
+
+def _gate(setup, engine, out_dir, **kw):
+    return PromotionGate.from_config(engine, str(out_dir), CCFG, **kw)
+
+
+def _candidate(setup, dirpath, name="candidate-0000.ckpt", scale=1.0):
+    p = jax.tree.map(lambda a: np.asarray(a) * scale, setup.params)
+    path = os.path.join(str(dirpath), name)
+    save_checkpoint(path, p, None, {"kind": "continual"})
+    return path
+
+
+# -- loop-off parity (tentpole contract) -------------------------------
+
+
+class TestLoopOffParity:
+    def test_prefilled_ring_finetune_bit_identical_to_window_free(
+        self, setup, tmp_path
+    ):
+        """Daemon off + pre-filled ring == the existing window-free path.
+
+        The same committed params fine-tuned (a) through the trainer
+        over the ring and (b) by hand through a fresh
+        ``make_series_superstep_fns`` over the plain series, with the
+        trainer's own block math replicated, must agree BIT-exactly —
+        the ring and the continual plumbing add no numerics.
+        """
+        ring = _ring(setup)
+        assert np.array_equal(np.asarray(ring.series()), setup.series)
+        trainer = _trainer(setup, ring, tmp_path)
+        path, health = trainer.finetune()
+        assert health["nonfinite"] == 0
+        trainer.commit()
+
+        fns = make_series_superstep_fns(
+            setup.model, optax.adam(1e-3), horizon=1, health=True
+        )
+        targets = SPEC.target_indices(64)[:-2].astype(np.int32)  # holdout=2
+        n, s, b = len(targets), CCFG.finetune_steps, CCFG.finetune_batch
+        idx = ((np.arange(s * b) + max(0, n - s * b)) % n)
+        idx = idx.reshape(s, b).astype(np.int32)
+        # stage fresh device copies: the superstep donates its params/
+        # opt-state operands, and setup.params must outlive this test
+        host = jax.tree.map(np.asarray, setup.params)
+        p2, _, losses, _ = fns.train_superstep(
+            jax.tree.map(jnp.asarray, host),
+            jax.tree.map(jnp.asarray,
+                         jax.tree.map(np.asarray,
+                                      optax.adam(1e-3).init(setup.params))),
+            jnp.asarray(setup.supports),
+            jnp.asarray(setup.series),
+            jnp.asarray(targets),
+            jnp.asarray(SPEC.offsets, jnp.int32),
+            jnp.asarray(idx),
+            jnp.ones((s, b), jnp.float32),
+        )
+        got = jax.tree_util.tree_leaves(trainer.params)
+        want = jax.tree_util.tree_leaves(jax.tree.map(np.asarray, p2))
+        assert len(got) == len(want)
+        for a, c in zip(got, want):
+            assert np.array_equal(a, c)  # BIT-exact, not allclose
+        assert health["loss_last"] == float(np.asarray(losses)[-1])
+        assert os.path.exists(path)
+
+    def test_discard_restores_committed_state(self, setup, tmp_path):
+        ring = _ring(setup)
+        trainer = _trainer(setup, ring, tmp_path)
+        before = [np.array(a) for a in jax.tree_util.tree_leaves(trainer.params)]
+        trainer.finetune()
+        trainer.discard()
+        after = jax.tree_util.tree_leaves(trainer.params)
+        for a, c in zip(before, after):
+            assert np.array_equal(a, c)
+
+
+# -- torn-write recovery (satellite) -----------------------------------
+
+
+def _tiny():
+    return {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+
+
+class TestTornWrite:
+    def test_destination_untouched_and_verified_load_recovers(self, tmp_path):
+        path = str(tmp_path / "latest.ckpt")
+        save_checkpoint(path, _tiny(), None, {"step": 1})
+        plan = FaultPlan(FaultSpec(kind="torn-write", path_glob="latest.ckpt"))
+        newer = {"w": np.full((2, 3), 7.0, np.float32)}
+        with pytest.raises(InjectedFault):
+            save_checkpoint(path, newer, None, {"step": 2}, fault_plan=plan)
+        orphans = [p for p in os.listdir(tmp_path) if ".tmp." in p]
+        assert orphans, "torn write must leave its partial tmp behind"
+        got = load_latest_verified(str(tmp_path), _tiny(), None,
+                                   load_opt_state=False)
+        assert got is not None
+        _, meta, params, _ = got
+        assert meta["step"] == 1  # the torn step-2 write never landed
+        assert np.array_equal(params["w"], _tiny()["w"])
+        # the fault is one-shot: the supervised retry lands cleanly
+        save_checkpoint(path, newer, None, {"step": 2}, fault_plan=plan)
+        _, meta2, params2, _ = load_latest_verified(
+            str(tmp_path), _tiny(), None, load_opt_state=False
+        )
+        assert meta2["step"] == 2 and np.array_equal(params2["w"], newer["w"])
+
+    def test_watcher_ignores_torn_orphan_then_swaps_clean_write(
+        self, setup, engine, tmp_path
+    ):
+        watcher = engine.watch_checkpoints(str(tmp_path))
+        gen0 = engine.generation
+        host = jax.tree.map(np.asarray, setup.fc.params)
+        plan = FaultPlan(FaultSpec(kind="torn-write", path_glob="latest.ckpt"))
+        with pytest.raises(InjectedFault):
+            save_checkpoint(str(tmp_path / "latest.ckpt"), host, None,
+                            {"step": 1}, fault_plan=plan)
+        assert watcher.poll() is False  # orphan tmp is not a checkpoint
+        assert engine.generation == gen0
+        save_checkpoint(str(tmp_path / "latest.ckpt"), host, None, {"step": 1})
+        assert watcher.poll() is True
+        assert engine.generation == gen0 + 1
+
+
+# -- promotion gate ----------------------------------------------------
+
+
+class TestPromotionGate:
+    def test_promote_rotates_and_swaps_through_watcher(
+        self, setup, engine, tmp_path
+    ):
+        gate = _gate(setup, engine, tmp_path)
+        gen0 = engine.generation
+        d = gate.consider(_candidate(setup, tmp_path), CLEAN)
+        assert d.accepted and d.reason == "promoted"
+        assert engine.generation == gen0 + 1 == d.generation
+        assert os.path.exists(tmp_path / "latest.ckpt")
+        d2 = gate.consider(
+            _candidate(setup, tmp_path, "candidate-0001.ckpt"), CLEAN
+        )
+        assert d2.accepted and engine.generation == gen0 + 2
+        # the prior live checkpoint rotated aside, not clobbered
+        assert os.path.exists(tmp_path / "latest.prev.ckpt")
+        assert gate.promotions == 2 and gate.rejections == 0
+
+    @pytest.mark.parametrize("health,reason", [
+        ({**CLEAN, "nonfinite": 3}, "nonfinite"),
+        ({**CLEAN, "grad_norm_max": float("nan")}, "grad-norm"),
+        ({**CLEAN, "grad_norm_max": 1e9}, "grad-norm"),
+        ({**CLEAN, "update_ratio_max": 500.0}, "update-ratio"),
+    ])
+    def test_typed_rejections_quarantine_without_touching_serving(
+        self, setup, engine, tmp_path, health, reason
+    ):
+        gate = _gate(setup, engine, tmp_path)
+        before = REGISTRY.counter(
+            "continual.rejections", {"reason": reason}
+        ).value
+        cand = _candidate(setup, tmp_path)
+        gen0 = engine.generation
+        d = gate.consider(cand, health)
+        assert not d.accepted and d.reason == reason
+        assert engine.generation == gen0
+        assert not os.path.exists(cand)
+        assert os.path.exists(f"{cand}.rejected-{reason}")
+        assert REGISTRY.counter(
+            "continual.rejections", {"reason": reason}
+        ).value == before + 1
+
+    def test_corrupt_candidate_rejected(self, setup, engine, tmp_path):
+        cand = str(tmp_path / "candidate-0000.ckpt")
+        with open(cand, "wb") as f:
+            f.write(b"not a checkpoint at all")
+        gate = _gate(setup, engine, tmp_path)
+        gen0 = engine.generation
+        d = gate.consider(cand, CLEAN)
+        assert not d.accepted and d.reason == "corrupt"
+        assert engine.generation == gen0
+
+    def test_eval_regression_rejected(self, setup, engine, tmp_path):
+        calls = []
+
+        def fake_eval(params):  # candidate scored first, then live
+            calls.append(1)
+            return 5.0 if len(calls) == 1 else 1.0
+
+        gate = _gate(setup, engine, tmp_path, holdout_eval=fake_eval,
+                     live_params=setup.params)
+        d = gate.consider(_candidate(setup, tmp_path), CLEAN)
+        assert not d.accepted and d.reason == "eval-regression"
+        assert len(calls) == 2
+
+    def test_injected_gate_crash_becomes_gate_error(
+        self, setup, engine, tmp_path
+    ):
+        gate = _gate(setup, engine, tmp_path)
+        cand = _candidate(setup, tmp_path)
+        gen0 = engine.generation
+        prior = getattr(engine, "_fault_plan", None)
+        engine._fault_plan = ServeFaultPlan(
+            ServeFaultSpec(kind="promotion-raise", dispatch=0)
+        )
+        try:
+            d = gate.consider(cand, CLEAN)
+        finally:
+            engine._fault_plan = prior
+        assert not d.accepted and d.reason == "gate-error"
+        assert os.path.exists(f"{cand}.rejected-gate-error")
+        assert engine.generation == gen0
+
+
+# -- daemon supervision ------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self, snap=None):
+        self._snap = snap
+
+    def drift_snapshot(self):
+        return self._snap
+
+
+class _StubGate:
+    def __init__(self, snap=None):
+        self._engine = _StubEngine(snap)
+
+
+class TestDaemon:
+    def test_cadence_trigger(self):
+        clock = [0.0]
+        cfg = ContinualConfig(enabled=True, cadence_s=10.0)
+        d = ContinualDaemon(None, _StubGate(), config=cfg,
+                            time_fn=lambda: clock[0])
+        clock[0] = 5.0
+        assert d.should_retrain() is None
+        clock[0] = 11.0
+        assert d.should_retrain() == "cadence"
+
+    @pytest.mark.parametrize("gauges,want", [
+        ({"n": 10, "z_max": 9.0, "psi": 0.1}, "drift"),   # z over 8.0
+        ({"n": 10, "z_max": 1.0, "psi": 0.9}, "drift"),   # psi over 0.5
+        ({"n": 10, "z_max": 1.0, "psi": 0.1}, None),
+    ])
+    def test_drift_trigger(self, gauges, want):
+        snap = {"schema_version": 1, "generation": 0,
+                "cities": {"0": {"commute": gauges}}}
+        d = ContinualDaemon(None, _StubGate(snap), config=CCFG)
+        assert d.should_retrain() == want
+
+    def test_down_daemon_never_fires(self):
+        cfg = ContinualConfig(enabled=True, cadence_s=0.001)
+        d = ContinualDaemon(None, _StubGate(), config=cfg)
+        d.down = True
+        time.sleep(0.002)
+        assert d.should_retrain() is None and d.poll() is None
+
+    def test_injected_crash_retried_with_backoff_then_promoted(
+        self, setup, engine, tmp_path
+    ):
+        plan = FaultPlan(FaultSpec(kind="raise", epoch=0, step=0))
+        trainer = _trainer(setup, _ring(setup), tmp_path, fault_plan=plan)
+        gate = _gate(setup, engine, tmp_path)
+        sleeps = []
+        daemon = ContinualDaemon(trainer, gate, config=CCFG,
+                                 sleep_fn=sleeps.append)
+        gen0 = engine.generation
+        d = daemon.retrain("cadence")
+        assert d is not None and d.accepted
+        assert daemon.restarts == 1 and len(sleeps) == 1
+        assert 0.001 <= sleeps[0] <= 0.002 * 1.1  # backoff with jitter
+        assert engine.generation == gen0 + 1
+        assert not daemon.down
+
+    def test_restart_budget_exhausts_into_down_serving_untouched(
+        self, setup, engine, tmp_path
+    ):
+        # one raise per fine-tune ordinal: every attempt dies
+        plan = FaultPlan(*[
+            FaultSpec(kind="raise", epoch=e, step=0) for e in range(5)
+        ])
+        cfg = ContinualConfig(
+            enabled=True, finetune_steps=2, finetune_batch=2,
+            max_restarts=1, backoff_s=0.001, backoff_max_s=0.002,
+        )
+        trainer = ContinualTrainer(
+            setup.model, optax.adam(1e-3), setup.supports, _ring(setup),
+            SPEC, cfg, str(tmp_path), params=setup.params, holdout=2,
+            fault_plan=plan,
+        )
+        gate = _gate(setup, engine, tmp_path)
+        daemon = ContinualDaemon(trainer, gate, config=cfg,
+                                 sleep_fn=lambda s: None)
+        gen0 = engine.generation
+        assert daemon.retrain("drift") is None
+        assert daemon.down
+        assert gate.ordinal == 0  # the gate never saw a candidate
+        assert engine.generation == gen0
+        assert REGISTRY.gauge("continual.daemon_up").value == 0
+        assert daemon.poll() is None  # retired, not retried
+
+    def test_torn_candidate_write_retried_through_supervision(
+        self, setup, engine, tmp_path
+    ):
+        plan = FaultPlan(
+            FaultSpec(kind="torn-write", path_glob="candidate-*.ckpt")
+        )
+        trainer = _trainer(setup, _ring(setup), tmp_path, fault_plan=plan)
+        gate = _gate(setup, engine, tmp_path)
+        daemon = ContinualDaemon(trainer, gate, config=CCFG,
+                                 sleep_fn=lambda s: None)
+        d = daemon.retrain("cadence")
+        assert d is not None and d.accepted
+        assert daemon.restarts == 1
+        orphans = [p for p in os.listdir(tmp_path / "candidates")
+                   if ".tmp." in p]
+        assert orphans, "the torn candidate tmp is left for forensics"
+
+    def test_hang_fault_delays_but_completes(self, setup, engine, tmp_path):
+        plan = FaultPlan(FaultSpec(kind="hang", hang_ms=20, epoch=0))
+        trainer = _trainer(setup, _ring(setup), tmp_path, fault_plan=plan)
+        gate = _gate(setup, engine, tmp_path)
+        daemon = ContinualDaemon(trainer, gate, config=CCFG)
+        t0 = time.perf_counter()
+        d = daemon.retrain("cadence")
+        assert time.perf_counter() - t0 >= 0.02
+        assert d is not None and d.accepted and daemon.restarts == 0
+
+    def test_background_thread_starts_and_stops_bounded(self):
+        cfg = ContinualConfig(enabled=True)  # no cadence: never fires
+        daemon = ContinualDaemon(None, _StubGate(), config=cfg)
+        daemon.start(poll_s=0.01)
+        time.sleep(0.05)
+        assert daemon.stop() is True
+        assert daemon.stop() is True  # idempotent
+
+
+# -- the end-to-end drill (what lint_gate.sh asserts on) ---------------
+
+
+class TestClosedLoopSmoke:
+    def test_verdict_counts(self, tmp_path):
+        out = closed_loop_smoke(str(tmp_path), poison=True, seed=0)
+        assert out["promotions"] == 1
+        assert out["rejections"] == 1
+        assert out["nonfinite"] == 0  # the clean fine-tune's stream
+        assert out["rejection_reason"] == "nonfinite"
+        assert out["generation"] == 1  # rejection left gen 1 serving
+        assert out["rows_ingested"] == 64 and out["ring_len"] == 64
+        assert out["predictions"] == 3 and not out["daemon_down"]
+        rejected = [
+            p for p in os.listdir(tmp_path / "candidates")
+            if p.endswith(".rejected-nonfinite")
+        ]
+        assert len(rejected) == 1

@@ -1,0 +1,270 @@
+"""Device-resident ingest ring tests: wraparound, numpy-oracle bit
+parity through the superstep gather, anomaly handling (gap /
+out-of-order / duplicate / nonfinite / stale reject), mid-ingest
+SIGTERM consistency, and the zero-recompiles-after-warmup property of
+the jitted ingest program.
+"""
+
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.data import (
+    SeriesRing,
+    StaleObservationError,
+    WindowSpec,
+    ingest_stream,
+)
+from stmgcn_tpu.obs.registry import MetricsRegistry
+from stmgcn_tpu.resilience import IngestFaultPlan, IngestFaultSpec
+from stmgcn_tpu.train.step import gather_window_batch
+
+
+def _series(T, N=4, C=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(T, N, C)).astype(np.float32)
+
+
+class OracleRing:
+    """Pure-host mirror of the documented ingest semantics, kept as a
+    growing list (no wraparound mechanics at all) — the ring's
+    :meth:`series` must equal the oracle's tail bit-for-bit."""
+
+    def __init__(self, start_ts, reorder_window):
+        self.start_ts = start_ts
+        self.reorder_window = reorder_window
+        self.rows: list[np.ndarray] = []
+
+    def ingest(self, ts, row):
+        row = np.asarray(row, np.float32)
+        nxt = self.start_ts + len(self.rows)
+        if not np.isfinite(row).all():
+            if ts >= nxt:
+                fill = self.rows[-1] if self.rows else np.zeros_like(row)
+                self.rows.extend([fill] * (ts + 1 - nxt))
+            return
+        if ts >= nxt:
+            fill = self.rows[-1] if self.rows else np.zeros_like(row)
+            self.rows.extend([fill] * (ts - nxt))
+            self.rows.append(row)
+        elif nxt - ts <= self.reorder_window:
+            self.rows[ts - self.start_ts] = row
+
+    def tail(self, n):
+        return np.stack(self.rows[-n:])
+
+
+class TestRingBasics:
+    def test_wraparound_at_exact_capacity(self):
+        full = _series(12)
+        ring = SeriesRing(12, 4, 2, start_ts=0, registry=MetricsRegistry())
+        for t in range(12):
+            ring.ingest(t, full[t])
+        assert len(ring) == 12
+        np.testing.assert_array_equal(np.asarray(ring.series()), full)
+        # one more row wraps: slot 0 is overwritten, view shifts by one
+        extra = _series(1, seed=9)[0]
+        ring.ingest(12, extra)
+        assert len(ring) == 12 and ring.origin_ts == 1
+        expect = np.concatenate([full[1:], extra[None]])
+        np.testing.assert_array_equal(np.asarray(ring.series()), expect)
+
+    def test_from_series_parity_and_tail(self):
+        full = _series(20)
+        reg = MetricsRegistry()
+        ring = SeriesRing.from_series(full, start_ts=7, registry=reg)
+        np.testing.assert_array_equal(np.asarray(ring.series()), full)
+        assert ring.origin_ts == 7 and ring.next_ts == 27
+        small = SeriesRing.from_series(full, start_ts=7, capacity=6,
+                                       registry=MetricsRegistry())
+        np.testing.assert_array_equal(np.asarray(small.series()), full[-6:])
+        # a pre-filled ring keeps ingesting exactly like a live one
+        more = _series(3, seed=5)
+        for i in range(3):
+            small.ingest(27 + i, more[i])
+        np.testing.assert_array_equal(
+            np.asarray(small.series()),
+            np.concatenate([full, more])[-6:],
+        )
+
+    def test_series_last_k_and_occupancy(self):
+        full = _series(10)
+        reg = MetricsRegistry()
+        ring = SeriesRing(16, 4, 2, start_ts=0, registry=reg)
+        for t in range(10):
+            ring.ingest(t, full[t])
+        np.testing.assert_array_equal(np.asarray(ring.series(last=4)), full[-4:])
+        assert reg.gauge("ring.occupancy", {"city": "0"}).value == 10 / 16
+        assert reg.counter("ingest.rows", {"city": "0"}).value == 10
+
+
+class TestOracleParity:
+    def test_messy_feed_matches_oracle_and_gather(self):
+        """A feed with gaps, bounded reordering, duplicates, and a
+        nonfinite row must land bit-identical to the host oracle, and
+        the superstep gather over the ring must equal the same gather
+        over the oracle series."""
+        full = _series(60, seed=3)
+        cap, win = 24, 3
+        ring = SeriesRing(cap, 4, 2, start_ts=0, reorder_window=win,
+                          registry=MetricsRegistry())
+        oracle = OracleRing(0, win)
+        events = []
+        t = 0
+        while t < 60:
+            if t == 10:          # gap: skip two timestamps
+                t += 2
+            if t == 20:          # swap within the reorder window
+                events += [(21, full[21]), (20, full[20])]
+                t = 22
+                continue
+            if t == 30:          # duplicate delivery
+                events += [(30, full[30]), (30, full[30])]
+                t = 31
+                continue
+            if t == 40:          # nonfinite observation
+                bad = full[40].copy()
+                bad[0, 0] = np.inf
+                events.append((40, bad))
+                t = 41
+                continue
+            events.append((t, full[t]))
+            t += 1
+        for ts, row in events:
+            ring.ingest(ts, row)
+            oracle.ingest(ts, row)
+        got = np.asarray(ring.series())
+        np.testing.assert_array_equal(got, oracle.tail(cap))
+        # gaps: two skipped timestamps at t=10, plus the slot the
+        # out-of-order pair forward-filled before its late half arrived
+        assert ring.gaps == 3 and ring.out_of_order == 1
+        assert ring.duplicates == 1 and ring.nonfinite == 1
+
+        spec = WindowSpec(serial_len=3, daily_len=1, weekly_len=0,
+                          day_timesteps=4, horizon=1)
+        targets = ring.target_indices(spec)
+        offsets = jnp.asarray(spec.offsets)
+        idx = jnp.arange(targets.shape[0])
+        x, y = gather_window_batch(ring.series(), jnp.asarray(targets),
+                                   offsets, idx)
+        ref = oracle.tail(cap)
+        np.testing.assert_array_equal(
+            np.asarray(x), ref[targets[:, None] + spec.offsets[None, :]])
+        np.testing.assert_array_equal(np.asarray(y), ref[targets])
+
+    def test_gap_forward_fill_is_deterministic(self):
+        full = _series(16, seed=4)
+        feeds = []
+        for _ in range(2):
+            ring = SeriesRing(16, 4, 2, start_ts=0,
+                              registry=MetricsRegistry())
+            for ts in (0, 1, 5, 6, 11):
+                ring.ingest(ts, full[ts])
+            feeds.append(np.asarray(ring.series()))
+        np.testing.assert_array_equal(feeds[0], feeds[1])
+        # fills repeat the last real row, bit-exactly
+        np.testing.assert_array_equal(feeds[0][2], full[1])
+        np.testing.assert_array_equal(feeds[0][4], full[1])
+        np.testing.assert_array_equal(feeds[0][7], full[6])
+
+    def test_gap_larger_than_capacity(self):
+        full = _series(4)
+        ring = SeriesRing(4, 4, 2, start_ts=0, reorder_window=2,
+                          registry=MetricsRegistry())
+        ring.ingest(0, full[0])
+        ring.ingest(100, full[1])  # 99 missing rows, only 4 slots resident
+        assert len(ring) == 4 and ring.next_ts == 101
+        got = np.asarray(ring.series())
+        np.testing.assert_array_equal(got[:3], np.broadcast_to(full[0], (3, 4, 2)))
+        np.testing.assert_array_equal(got[3], full[1])
+        assert ring.gaps == 99
+
+
+class TestAnomalies:
+    def test_timestamp_regression_rejected(self):
+        full = _series(10)
+        ring = SeriesRing(8, 4, 2, start_ts=0, reorder_window=2,
+                          registry=MetricsRegistry())
+        for t in range(8):
+            ring.ingest(t, full[t])
+        with pytest.raises(StaleObservationError):
+            ring.ingest(3, full[3])  # 5 behind, window is 2
+        with pytest.raises(StaleObservationError):
+            ring.ingest(-1, full[0])  # before the ring's first timestamp
+        # the reject changed nothing
+        np.testing.assert_array_equal(np.asarray(ring.series()), full[:8])
+
+    def test_nonfinite_quarantined_and_counted(self):
+        full = _series(6)
+        reg = MetricsRegistry()
+        ring = SeriesRing(8, 4, 2, start_ts=0, registry=reg)
+        ring.ingest(0, full[0])
+        bad = full[1].copy()
+        bad[1, 0] = np.nan
+        assert ring.ingest(1, bad) == "nonfinite"
+        ring.ingest(2, full[2])
+        got = np.asarray(ring.series())
+        assert np.isfinite(got).all()
+        np.testing.assert_array_equal(got[1], full[0])  # forward-filled
+        assert ring.quarantined == [(1, "nonfinite")]
+        assert reg.counter("ingest.nonfinite", {"city": "0"}).value == 1
+
+    def test_ingest_stream_counts_rejects(self):
+        full = _series(10)
+        ring = SeriesRing(8, 4, 2, start_ts=0, reorder_window=1,
+                          registry=MetricsRegistry())
+        rows = [(t, full[t]) for t in range(8)] + [(2, full[2])]
+        summary = ingest_stream(ring, rows)
+        assert summary == {"fed": 9, "accepted": 8, "rejected": 1}
+
+
+class TestSigterm:
+    def test_mid_ingest_sigterm_leaves_ring_consistent(self):
+        """SIGTERM delivered mid-stream (by the ingest fault plan) must
+        leave every committed row fully written and the bookkeeping
+        matching the device state — and the feed must be resumable to a
+        state bit-identical to an uninterrupted one."""
+
+        class _Term(Exception):
+            pass
+
+        def _handler(signum, frame):
+            raise _Term
+
+        full = _series(12)
+        ring = SeriesRing(8, 4, 2, start_ts=0, registry=MetricsRegistry())
+        plan = IngestFaultPlan([IngestFaultSpec(kind="sigterm", row=5)])
+        rows = [(t, full[t]) for t in range(12)]
+        old = signal.signal(signal.SIGTERM, _handler)
+        try:
+            with pytest.raises(_Term):
+                ingest_stream(ring, rows, plan)
+        finally:
+            signal.signal(signal.SIGTERM, old)
+        # rows 0-4 committed; row 5 (in flight) is not visible anywhere
+        assert ring.count == 5 and len(ring) == 5
+        np.testing.assert_array_equal(np.asarray(ring.series()), full[:5])
+        # resuming the feed converges to the uninterrupted result
+        ingest_stream(ring, rows[5:], plan)
+        np.testing.assert_array_equal(np.asarray(ring.series()), full[-8:])
+
+
+class TestZeroRecompiles:
+    def test_ingest_adds_zero_compiles_after_warmup(self):
+        from stmgcn_tpu.obs import jaxmon
+
+        if not jaxmon.install():
+            pytest.skip("jax.monitoring unavailable")
+        full = _series(20, seed=8)
+        ring = SeriesRing(6, 4, 2, start_ts=0, reorder_window=2,
+                          registry=MetricsRegistry())
+        ring.ingest(0, full[0])   # warmup: traces the ingest program
+        ring.series()             # and the (unwrapped) view slice
+        compiles = jaxmon.REGISTRY.counter("jax.compilations")
+        baseline = compiles.value
+        for t in range(1, 15):    # wraps the ring twice over
+            ring.ingest(t, full[t])
+        ring.ingest(13, full[13])  # late path reuses the same program
+        assert compiles.value == baseline

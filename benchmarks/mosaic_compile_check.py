@@ -22,7 +22,11 @@ Each configuration {bf16, fp32} x {fwd, grad} x {plain, vmapped M=3}
 compiles in a KILLABLE child process under the bench lock (the compile
 rides the same tunnel that wedges, and concurrent libtpu inits fight
 over /tmp/libtpu_lockfile), one JSON line per config with the tail of
-the compiler error on failure. Exit 0 iff every configuration compiles.
+the compiler error on failure. Two extra configs compile the tiled-
+sparse SpMM program (``ops/tiling.py`` plan -> ``spmm_stack`` fwd/grad
+at tile=128, the bench largeN path's on-chip kernel) so the probe loop
+captures on-chip evidence for it the moment hardware returns. Exit 0
+iff every configuration compiles.
 
 Run it the moment the tunnel's compile path answers — it settles "does
 the kernel build under real Mosaic" in minutes, before the chip itself
@@ -114,9 +118,48 @@ print("COMPILE_OK")
 """
 
 
-def check(dtype: str, mode: str, vmapped: str, timeout_s: int) -> dict:
-    src = CHILD_SRC.format(repo=REPO, dtype=dtype, mode=mode, vmapped=vmapped)
-    rec = {"config": f"{dtype}/{mode}/{vmapped}"}
+TILED_SRC = """
+import sys
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, {repo!r})
+from stmgcn_tpu.data.synthetic import grid_adjacency
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.ops.spmm import spmm_stack
+from stmgcn_tpu.ops.tiling import plan_tiling
+
+mode = {mode!r}
+
+topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2x1")
+mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+sh = NamedSharding(mesh, P())
+
+# tile=128 at the shipped kernel regime: per-grid-step VMEM depends only
+# on the tile and the m<=256 column ceiling, never on how many blocks the
+# plan keeps, so a small-N plan compiles the same program shape the
+# bench's N=8192 largeN path runs on chip
+dense = SupportConfig("chebyshev", 2).build_all([grid_adjacency(16)] * 3)
+plan = plan_tiling(np.asarray(dense, np.float32), tile=128)
+stack = plan[0].as_stack()
+
+def fwd(x):
+    return spmm_stack(stack, x)
+
+def loss(x):
+    return jnp.sum(fwd(x).astype(jnp.float32) ** 2)
+
+fn = jax.grad(loss) if mode == "grad" else fwd
+x = jax.ShapeDtypeStruct((plan.n, 256), jnp.float32, sharding=sh)
+jax.jit(fn).lower(x).compile()
+print("COMPILE_OK")
+"""
+
+
+def _run_child(src: str, config: str, timeout_s: int) -> dict:
+    rec = {"config": config}
     try:
         out = subprocess.run(
             [sys.executable, "-c", src], timeout=timeout_s, capture_output=True
@@ -135,6 +178,17 @@ def check(dtype: str, mode: str, vmapped: str, timeout_s: int) -> dict:
         ]
         rec["error"] = ("\n".join(key_lines[-4:]) or err[-500:])[-800:]
     return rec
+
+
+def check(dtype: str, mode: str, vmapped: str, timeout_s: int) -> dict:
+    src = CHILD_SRC.format(repo=REPO, dtype=dtype, mode=mode, vmapped=vmapped)
+    return _run_child(src, f"{dtype}/{mode}/{vmapped}", timeout_s)
+
+
+def check_tiled(mode: str, timeout_s: int) -> dict:
+    """AOT-compile the tiled SpMM program (fwd or grad) under real Mosaic."""
+    src = TILED_SRC.format(repo=REPO, mode=mode)
+    return _run_child(src, f"float32/{mode}/tiled-spmm", timeout_s)
 
 
 def _real_error(err: str) -> bool:
@@ -178,6 +232,11 @@ def main() -> None:
                 ok_all &= rec["ok"]
                 results.append(rec)
                 print(json.dumps(rec), flush=True)
+    for mode in ("fwd", "grad"):
+        rec = check_tiled(mode, timeout_s)
+        ok_all &= rec["ok"]
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
     lock.release()
     # a run that produced at least one REAL verdict (success or an actual
     # compiler error — not a timeout and not tunnel-infrastructure

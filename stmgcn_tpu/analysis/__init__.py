@@ -49,9 +49,12 @@ by tier-1 (``tests/test_analysis.py``):
   reservoir budgets, cadence, :mod:`.health_check`), and static Pallas
   kernel checks (:mod:`.pallas_check`):
   grid/BlockSpec divisibility plus a calibrated VMEM-footprint estimate
-  for every ``pl.pallas_call`` site in :mod:`stmgcn_tpu.ops.pallas_lstm`,
-  reproducing the known 18.04 MB fp32-forward Mosaic OOM from source
-  alone.
+  for every ``pl.pallas_call`` site in :mod:`stmgcn_tpu.ops.pallas_lstm`
+  and :mod:`stmgcn_tpu.ops.spmm`, reproducing the known 18.04 MB
+  fp32-forward Mosaic OOM from source alone, and tiled-support plan
+  math for every preset that turns on ``model.tiled`` (knob ranges,
+  mode conflicts, tile-grid node-padding waste vs the budget, kernel
+  VMEM at the configured tile — :mod:`.tiling_check`).
 
 Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 ``# stmgcn: ignore``) on the offending line.
@@ -75,6 +78,7 @@ from stmgcn_tpu.analysis.serving_check import (
     check_serving_slo,
 )
 from stmgcn_tpu.analysis.sharding_check import check_partition_specs
+from stmgcn_tpu.analysis.tiling_check import check_tile_plan
 
 __all__ = [
     "Finding",
@@ -93,6 +97,7 @@ __all__ = [
     "check_serving_buckets",
     "check_serving_slo",
     "check_step_contracts",
+    "check_tile_plan",
     "lint_package",
     "lint_paths",
     "lint_source",

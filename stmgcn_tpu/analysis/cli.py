@@ -126,6 +126,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             check_serving_slo,
         )
         from stmgcn_tpu.analysis.sharding_check import check_partition_specs
+        from stmgcn_tpu.analysis.tiling_check import check_tile_plan
         from stmgcn_tpu.utils.platform import force_host_platform
 
         force_host_platform("cpu")
@@ -138,6 +139,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_obs_overhead())
         findings.extend(check_health_overhead())
         findings.extend(check_continual_config())
+        findings.extend(check_tile_plan())
         # static Pallas checks ride the contract section: deriving the
         # kernel's real block sizes imports ops.pallas_lstm (jax), which
         # --no-contracts' no-JAX promise must not do

@@ -31,6 +31,18 @@ The extraction is genuinely syntactic — edit a BlockSpec in
 source. If the source drifts past what the evaluator understands (new
 variable names, a new pallas_call site), the check fails loudly with a
 ``pallas-blockspec`` out-of-sync finding rather than silently passing.
+
+The site model covers every ``pl.pallas_call`` in the repo — the LSTM
+kernels above plus the three block-sparse SpMM launches in
+``ops/spmm.py`` (``_spmm_call``, ``_stack_fwd_call``,
+``_stack_bwd_call``). The SpMM sites wrap their geometry in
+``pltpu.PrefetchScalarGridSpec`` (scalar-prefetched block-column index
+lists), so the extractor also unwraps ``grid_spec=`` keywords, aligns
+``in_specs`` against the operands *after* the prefetch arguments, and
+classifies ``idx_ref[i, c]``-indexed axes as dynamically streamed
+(gathered — double-buffered like any streamed block, but with no
+statically checkable grid coverage). The prefetch index list itself
+lives in SMEM and is excluded from the VMEM estimate.
 """
 
 from __future__ import annotations
@@ -45,8 +57,10 @@ from stmgcn_tpu.analysis.report import Finding
 from stmgcn_tpu.analysis.rules import RULES
 
 __all__ = [
+    "KERNEL_MODULES",
     "KernelPoint",
     "PallasSite",
+    "SpmmKernelPoint",
     "VMEM_BUDGET_BYTES",
     "check_pallas_kernels",
     "extract_pallas_sites",
@@ -66,6 +80,14 @@ PIPELINE_FACTOR = 2
 CALIBRATION = 2.1064
 
 _ITEMSIZE = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+#: every module that owns a ``pl.pallas_call`` site, relative to the
+#: package root; ``check_pallas_kernels`` covers them all by default and
+#: tests/test_analysis.py asserts the repo grows no uncovered site
+KERNEL_MODULES = ("ops/pallas_lstm.py", "ops/spmm.py")
+
+_LSTM_FNS = frozenset({"_run_fwd", "_fused_bwd"})
+_SPMM_FNS = frozenset({"_spmm_call", "_stack_fwd_call", "_stack_bwd_call"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,15 +131,52 @@ class KernelPoint:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpmmKernelPoint:
+    """One concrete block-sparse SpMM configuration (``ops/spmm.py``).
+
+    Defaults are the largeN bench plan: N = R x 128 = 8192 permuted
+    nodes, K = 3 Chebyshev supports per stacked launch, C stored block
+    columns per row, and M dense signal columns (batch x features).
+    ``r_t``/``c_max_t`` size the pre-transposed backward stacks.
+    """
+
+    dtype: str = "float32"
+    tile: int = 128
+    k: int = 3
+    r: int = 64
+    c_max: int = 8
+    r_t: int = 64
+    c_max_t: int = 8
+    m: int = 256
+
+    @property
+    def itemsize(self) -> int:
+        return _ITEMSIZE[self.dtype]
+
+    def describe(self) -> str:
+        return (
+            f"{self.dtype} tile={self.tile} K={self.k} R={self.r} "
+            f"C={self.c_max} M={self.m}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class BlockUse:
-    """One operand's block at one site: shape, full shape, streaming."""
+    """One operand's block at one site: shape, full shape, streaming.
+
+    ``roles`` is the per-axis index-map classification (None for a
+    spec without an index map): ``("const", None)`` revisits one block,
+    ``("param", p)`` is driven directly by grid parameter ``p`` (its
+    coverage is statically checkable), ``("dynamic", None)`` is a
+    computed index — the SpMM kernels' ``idx_ref[i, c]`` gathers.
+    """
 
     operand: str
     block: Tuple[int, ...]
     operand_shape: Tuple[int, ...]
     itemsize: int
     streamed: bool
-    streamed_axis: Optional[int]
+    roles: Optional[Tuple[Tuple[str, Optional[int]], ...]]
 
     @property
     def nbytes(self) -> int:
@@ -136,6 +195,9 @@ class PallasSite:
     out_specs: List[ast.expr]
     out_shape: List[ast.expr]
     operands: List[str]  # names of the arrays the wrapped call receives
+    #: leading operands consumed by PrefetchScalarGridSpec (SMEM scalars
+    #: — no in_spec, no VMEM block)
+    num_scalar_prefetch: int = 0
 
 
 class _Unresolved(Exception):
@@ -186,11 +248,11 @@ def _ev(node: ast.AST, names: Dict[str, object]):
     raise _Unresolved(ast.dump(node))
 
 
-def _default_kernel_path() -> str:
+def _default_kernel_path(module: str = "ops/pallas_lstm.py") -> str:
     import stmgcn_tpu
 
     pkg = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
-    return os.path.join(pkg, "ops", "pallas_lstm.py")
+    return os.path.join(pkg, *module.split("/"))
 
 
 def extract_pallas_sites(path: Optional[str] = None) -> List[PallasSite]:
@@ -212,14 +274,25 @@ def extract_pallas_sites(path: Optional[str] = None) -> List[PallasSite]:
     class _Finder(ast.NodeVisitor):
         def __init__(self):
             self._stack: List[str] = []
+            self._assigns: List[Dict[str, ast.expr]] = [{}]
 
         def _handle_func(self, node):
             self._stack.append(node.name)
+            self._assigns.append({})
             self.generic_visit(node)
+            self._assigns.pop()
             self._stack.pop()
 
         visit_FunctionDef = _handle_func
         visit_AsyncFunctionDef = _handle_func
+
+        def visit_Assign(self, node: ast.Assign) -> None:
+            # remember function-local `name = expr` so a
+            # `grid_spec=pltpu.PrefetchScalarGridSpec(...)` bound to a
+            # variable first still resolves to its construction
+            if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                self._assigns[-1][node.targets[0].id] = node.value
+            self.generic_visit(node)
 
         def visit_Call(self, node: ast.Call) -> None:
             # shape: pl.pallas_call(kernel, grid=..., ...)(op0, op1, ...)
@@ -228,6 +301,21 @@ def extract_pallas_sites(path: Optional[str] = None) -> List[PallasSite]:
                 if d and d.split(".")[-1] == "pallas_call":
                     inner = node.func
                     kw = {k.arg: k.value for k in inner.keywords}
+                    nsp = 0
+                    gs = kw.get("grid_spec")
+                    if isinstance(gs, ast.Name):
+                        for scope in reversed(self._assigns):
+                            if gs.id in scope:
+                                gs = scope[gs.id]
+                                break
+                    if isinstance(gs, ast.Call):
+                        gkw = {k.arg: k.value for k in gs.keywords}
+                        n = gkw.get("num_scalar_prefetch")
+                        if isinstance(n, ast.Constant):
+                            nsp = int(n.value)
+                        # grid/in_specs/out_specs live on the grid spec;
+                        # out_shape stays on the pallas_call itself
+                        kw = {**gkw, **kw}
                     operands = [
                         a.id if isinstance(a, ast.Name) else f"<arg{i}>"
                         for i, a in enumerate(node.args)
@@ -249,6 +337,7 @@ def extract_pallas_sites(path: Optional[str] = None) -> List[PallasSite]:
                             out_specs=elts("out_specs"),
                             out_shape=elts("out_shape"),
                             operands=operands,
+                            num_scalar_prefetch=nsp,
                         )
                     )
             self.generic_visit(node)
@@ -261,10 +350,61 @@ def _round_up(n: int, block: int) -> int:
     return -(-n // block) * block
 
 
-def _site_env(site: PallasSite, point: KernelPoint) -> Dict[str, object]:
+def _spmm_site_env(site: PallasSite, point: SpmmKernelPoint) -> Dict[str, object]:
+    """Shape bindings of the ``ops/spmm.py`` launch wrappers at ``point``
+    — mirrors ``_spmm_call`` / ``_stack_fwd_call`` / ``_stack_bwd_call``
+    (``tm = min(256, ceil(M, TILE))`` column tiling, row padding to the
+    block grid). The scalar-prefetched index list is SMEM-resident and
+    carries no BlockSpec, so it appears in the shape table only."""
+    t = point.tile
+    tm = min(256, _round_up(point.m, 128))
+    m_pad = _round_up(point.m, tm)
+    common = {
+        "tile": t, "tm": tm, "m_pad": m_pad, "mb": m_pad // tm,
+        "jnp.float32": 4,
+    }
+    if site.fn == "_spmm_call":
+        r, c = point.r, point.c_max
+        shapes = {
+            "idx": (r, c),
+            "data": (r, c, t, t),
+            "x_pad": (r * t, m_pad),
+        }
+        return {**common, "r": r, "c_max": c, "n_pad": r * t,
+                "__shapes__": shapes}
+    if site.fn == "_stack_fwd_call":
+        k, r, c = point.k, point.r, point.c_max
+        shapes = {
+            "idx": (k, r, c),
+            "data": (k, r, c, t, t),
+            # the signal is passed as x_pad[None] — a subscript, so the
+            # extractor names it positionally
+            "<arg2>": (1, r * t, m_pad),
+        }
+        return {**common, "k": k, "r": r, "c_max": c, "__shapes__": shapes}
+    if site.fn == "_stack_bwd_call":
+        k, r_t, c_t = point.k, point.r_t, point.c_max_t
+        shapes = {
+            "idx_t": (k, r_t, c_t),
+            "data_t": (k, r_t, c_t, t, t),
+            "g_pad": (k, point.r * t, m_pad),
+        }
+        return {**common, "k": k, "r_t": r_t, "c_max_t": c_t,
+                "__shapes__": shapes}
+    raise _Unresolved(f"unknown spmm pallas_call site `{site.fn}`")
+
+
+def _site_env(site: PallasSite, point) -> Dict[str, object]:
     """The enclosing function's shape bindings at ``point`` — mirrors
-    the arithmetic of ``_run_fwd`` / ``_fused_bwd`` in ops/pallas_lstm.py.
+    the arithmetic of ``_run_fwd`` / ``_fused_bwd`` in ops/pallas_lstm.py
+    and the SpMM launch wrappers in ops/spmm.py.
     Unknown sites raise :class:`_Unresolved` (checker out of sync)."""
+    if site.fn in _SPMM_FNS or isinstance(point, SpmmKernelPoint):
+        if not (site.fn in _SPMM_FNS and isinstance(point, SpmmKernelPoint)):
+            raise _Unresolved(
+                f"site `{site.fn}` checked against {type(point).__name__}"
+            )
+        return _spmm_site_env(site, point)
     H, T, L = point.hidden, point.seq_len, point.layers
     four_h, h_dim = 4 * H, H
     fwd_block, bwd_block = point.block_rows()
@@ -323,27 +463,38 @@ def _spec_parts(spec: ast.expr) -> Tuple[ast.expr, Optional[ast.Lambda]]:
     return shape, imap
 
 
-def _streamed_axis(imap: Optional[ast.Lambda]) -> Optional[int]:
-    """Index of the block axis driven by the grid index; None = constant.
+def _axis_roles(
+    imap: Optional[ast.Lambda],
+) -> Optional[Tuple[Tuple[str, Optional[int]], ...]]:
+    """Classify each block axis of an index map (None for no map).
 
-    ``lambda i: (0, i, 0)`` streams axis 1; an index map that ignores its
-    parameter revisits one block every grid step (resident/accumulator).
+    ``lambda i: (0, i, 0)`` -> const/param-0/const; multi-parameter
+    maps (``lambda ki, i, j, c, idx_ref: ...``) record which lambda
+    parameter drives each axis; any computed index that references a
+    parameter (``idx_ref[ki, i, c]``) is ``dynamic`` — streamed, but
+    with no statically checkable coverage. A map that ignores every
+    parameter revisits one block per grid step (resident/accumulator).
     """
     if imap is None or not imap.args.args:
         return None
-    param = imap.args.args[0].arg
+    params = [a.arg for a in imap.args.args]
     body = imap.body
     elts = body.elts if isinstance(body, (ast.Tuple, ast.List)) else [body]
-    for axis, e in enumerate(elts):
-        if any(
-            isinstance(s, ast.Name) and s.id == param for s in ast.walk(e)
+    roles: List[Tuple[str, Optional[int]]] = []
+    for e in elts:
+        if isinstance(e, ast.Name) and e.id in params:
+            roles.append(("param", params.index(e.id)))
+        elif any(
+            isinstance(s, ast.Name) and s.id in params for s in ast.walk(e)
         ):
-            return axis
-    return None
+            roles.append(("dynamic", None))
+        else:
+            roles.append(("const", None))
+    return tuple(roles)
 
 
 def _site_blocks(
-    site: PallasSite, point: KernelPoint
+    site: PallasSite, point
 ) -> Tuple[Tuple[int, ...], List[BlockUse]]:
     """Evaluate the site at ``point`` -> (grid, every operand's block)."""
     env = _site_env(site, point)
@@ -353,21 +504,30 @@ def _site_blocks(
     grid_v = _ev(site.grid, names) if site.grid is not None else (1,)
     grid = tuple(grid_v) if isinstance(grid_v, tuple) else (int(grid_v),)
 
-    uses: List[BlockUse] = []
-    if len(site.in_specs) != len(site.operands):
-        raise _Unresolved(
-            f"{site.fn}: {len(site.in_specs)} in_specs for "
-            f"{len(site.operands)} operands"
-        )
-    for spec, operand in zip(site.in_specs, site.operands):
+    def use_of(operand, spec, itemsize):
         shape_e, imap = _spec_parts(spec)
         block = tuple(_ev(shape_e, names))
+        roles = _axis_roles(imap)
+        streamed = roles is not None and any(
+            kind != "const" for kind, _ in roles
+        )
+        return block, roles, streamed
+
+    uses: List[BlockUse] = []
+    # scalar-prefetched leading operands carry no BlockSpec (SMEM)
+    specced = site.operands[site.num_scalar_prefetch:]
+    if len(site.in_specs) != len(specced):
+        raise _Unresolved(
+            f"{site.fn}: {len(site.in_specs)} in_specs for "
+            f"{len(specced)} post-prefetch operands"
+        )
+    for spec, operand in zip(site.in_specs, specced):
         if operand not in op_shapes:
             raise _Unresolved(f"{site.fn}: unknown operand `{operand}`")
-        axis = _streamed_axis(imap)
+        block, roles, streamed = use_of(operand, spec, point.itemsize)
         uses.append(
             BlockUse(operand, block, op_shapes[operand], point.itemsize,
-                     axis is not None, axis)
+                     streamed, roles)
         )
     if len(site.out_specs) != len(site.out_shape):
         raise _Unresolved(
@@ -375,16 +535,13 @@ def _site_blocks(
             f"{len(site.out_shape)} out_shape structs"
         )
     for i, (spec, struct) in enumerate(zip(site.out_specs, site.out_shape)):
-        shape_e, imap = _spec_parts(spec)
-        block = tuple(_ev(shape_e, names))
         if not (isinstance(struct, ast.Call) and len(struct.args) >= 2):
             raise _Unresolved(f"{site.fn}: out_shape[{i}] not a struct")
         full = tuple(_ev(struct.args[0], names))
         itemsize = int(_ev(struct.args[1], names))
-        axis = _streamed_axis(imap)
+        block, roles, streamed = use_of(f"<out{i}>", spec, itemsize)
         uses.append(
-            BlockUse(f"<out{i}>", block, full, itemsize,
-                     axis is not None, axis)
+            BlockUse(f"<out{i}>", block, full, itemsize, streamed, roles)
         )
     return grid, uses
 
@@ -443,19 +600,22 @@ def _check_site(site: PallasSite, point: KernelPoint) -> List[Finding]:
                     f"the operand dim {full} — Mosaic pads or rejects the "
                     "ragged final block",
                 )
-        if u.streamed and u.streamed_axis is not None:
-            axis = u.streamed_axis
-            if axis < len(u.block) and grid:
-                covered = grid[0] * u.block[axis]
-                if covered != u.operand_shape[axis]:
-                    emit(
-                        "pallas-blockspec",
-                        f"`{site.fn}` [{point.describe()}]: grid {grid[0]} x "
-                        f"block {u.block[axis]} covers {covered} of "
-                        f"{u.operand_shape[axis]} rows of `{u.operand}` — "
-                        "the kernel would read/write a row range it was "
-                        "never given",
-                    )
+        for axis, (kind, pos) in enumerate(u.roles or ()):
+            # only directly grid-driven axes have static coverage;
+            # "dynamic" (idx_ref-gathered) axes are checked at runtime
+            # by construction of the index lists
+            if kind != "param" or axis >= len(u.block) or pos >= len(grid):
+                continue
+            covered = grid[pos] * u.block[axis]
+            if covered != u.operand_shape[axis]:
+                emit(
+                    "pallas-blockspec",
+                    f"`{site.fn}` [{point.describe()}]: grid {grid[pos]} x "
+                    f"block {u.block[axis]} covers {covered} of "
+                    f"{u.operand_shape[axis]} rows of `{u.operand}` axis "
+                    f"{axis} — the kernel would read/write a range it was "
+                    "never given",
+                )
 
     est = vmem_estimate(site, point)
     if est["estimate_bytes"] > VMEM_BUDGET_BYTES:
@@ -475,17 +635,31 @@ def _check_site(site: PallasSite, point: KernelPoint) -> List[Finding]:
 def check_pallas_kernels(
     points: Optional[Iterable[KernelPoint]] = None,
     path: Optional[str] = None,
+    spmm_points: Optional[Iterable[SpmmKernelPoint]] = None,
 ) -> List[Finding]:
-    """Check every extracted pallas_call site at every ``point``.
+    """Check every extracted pallas_call site at every matching point.
 
-    Default points: the bench configuration in both storage dtypes, with
-    blocks derived by the kernel's own ``_block_rows`` (env overrides
-    included, so an operator's ``STMGCN_PALLAS_FWD_ROWS`` experiment is
-    checked as configured).
+    Default LSTM points: the bench configuration in both storage dtypes,
+    with blocks derived by the kernel's own ``_block_rows`` (env
+    overrides included, so an operator's ``STMGCN_PALLAS_FWD_ROWS``
+    experiment is checked as configured). Default SpMM point: the largeN
+    bench plan at the shipped tile. With no explicit ``path`` every
+    module in :data:`KERNEL_MODULES` is covered; a given ``path`` scopes
+    the check to that file (fixtures), still dispatching each site to
+    its point family by function name.
     """
     if points is None:
         points = [KernelPoint(dtype="float32"), KernelPoint(dtype="bfloat16")]
-    sites = extract_pallas_sites(path)
+    if spmm_points is None:
+        spmm_points = [SpmmKernelPoint()]
+    if path is not None:
+        sites = extract_pallas_sites(path)
+    else:
+        sites = [
+            s
+            for module in KERNEL_MODULES
+            for s in extract_pallas_sites(_default_kernel_path(module))
+        ]
     if not sites:
         return [
             Finding(
@@ -500,6 +674,6 @@ def check_pallas_kernels(
         ]
     findings: List[Finding] = []
     for site in sites:
-        for point in points:
+        for point in (spmm_points if site.fn in _SPMM_FNS else points):
             findings.extend(_check_site(site, point))
     return findings

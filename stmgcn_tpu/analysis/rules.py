@@ -216,6 +216,17 @@ _ALL_RULES = [
         "scoped budget — Mosaic aborts compilation on a real chip",
     ),
     Rule(
+        "tile-plan",
+        "error",
+        "a preset's tiled-support plan cannot hold: tile_size/"
+        "tile_waste_budget outside their ranges, tiled combined with "
+        "sparse or a >1-device mesh, node padding on the tile grid "
+        "already past the waste budget (build_supports guaranteed to "
+        "raise), or the tiled SpMM's calibrated VMEM estimate at the "
+        "configured tile size past the ~16 MiB/core budget — pure "
+        "config math, detectable before any adjacency is built",
+    ),
+    Rule(
         "partition-axis-name",
         "error",
         "PartitionSpec names a mesh axis that no mesh in this repo defines "

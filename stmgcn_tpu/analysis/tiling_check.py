@@ -1,0 +1,173 @@
+"""Pass 2i: tiled-support plan contracts — pure config math.
+
+The tiled-sparse path (``ops/tiling.py`` + ``model.tiled``) commits at
+config time to a tile size, a condensation waste budget, and the claim
+that the fused SpMM kernels fit in VMEM at that tile. All three are
+checkable before any adjacency is built, the same way ``fleet-shape-
+class`` re-runs the planner host-side:
+
+- **knob ranges** — ``tile_size >= 1`` and ``tile_waste_budget`` in
+  ``(0, 1]`` (``build_supports`` raises on violation at plan time, but
+  a preset should not ship a config that cannot plan);
+- **mode conflicts** — ``model.tiled`` with ``model.sparse`` (the two
+  non-dense layouts are mutually exclusive) or with a >1-device mesh
+  (tiled plans are single-device; ``route_supports`` rejects both);
+- **node-padding waste** — each city's node count rounds up to the tile
+  grid (``ceil(N / tile) * tile``); when the padding rows alone exceed
+  ``tile_waste_budget``, the realized condensation waste *must* exceed
+  the budget too and ``build_supports`` is guaranteed to raise. A
+  config-time certainty, flagged before any data is generated;
+- **kernel VMEM at the configured tile** — the calibrated footprint
+  model from :mod:`.pallas_check` (same ``CALIBRATION`` constant, same
+  double-buffered streamed blocks) at the tiled SpMM's worst-case
+  column tile (``tm = 256``): one ``(tile, tile)`` support block plus
+  the gathered signal block and the output block. Past ~16 MiB/core
+  Mosaic aborts compilation — the exact boundary the ``pallas-vmem``
+  rule pins for the shipped kernels, here evaluated at a *configured*
+  tile instead of the shipped one (tile=512 clears it, tile=1024 does
+  not).
+
+No data build, no trace.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = [
+    "check_tile_plan",
+    "tile_plan_violations",
+    "tiled_spmm_vmem_estimate",
+]
+
+#: the kernels' column-tile ceiling (ops/spmm.py: ``tm = min(256, ...)``)
+_TM_WORST = 256
+
+
+def _ceil_to(n: int, t: int) -> int:
+    return -(-n // t) * t
+
+
+def tiled_spmm_vmem_estimate(tile: int, itemsize: int = 4) -> float:
+    """Calibrated VMEM bytes of one tiled SpMM launch at ``tile``.
+
+    Worst-case operand set per grid step: the ``(tile, tile)`` support
+    block, the gathered ``(tile, tm)`` signal block, and the ``(tile,
+    tm)`` output block — all streamed, so double-buffered, under the
+    same fitted calibration as :func:`.pallas_check.vmem_estimate`.
+    """
+    from stmgcn_tpu.analysis.pallas_check import CALIBRATION, PIPELINE_FACTOR
+
+    streamed = (tile * tile + 2 * tile * _TM_WORST) * itemsize
+    return CALIBRATION * PIPELINE_FACTOR * streamed
+
+
+def tile_plan_violations(
+    model_cfg, n_nodes: Union[int, Sequence[int]]
+) -> List[str]:
+    """Config-arithmetic violations of one model config's tiled plan.
+
+    ``n_nodes`` is the city node count, or one count per city for a
+    heterogeneous preset. Returns human-readable messages; empty when
+    the config is not tiled or the plan is viable.
+    """
+    m = model_cfg
+    msgs: List[str] = []
+    if not getattr(m, "tiled", False):
+        return msgs
+    if m.sparse:
+        msgs.append(
+            "model.tiled and model.sparse are mutually exclusive — the "
+            "offline tile plan replaces the banded/sparse layout"
+        )
+    if m.tile_size < 1:
+        msgs.append(
+            f"model.tile_size must be >= 1, got {m.tile_size} — "
+            "plan_tiling rejects it"
+        )
+        return msgs
+    if not 0.0 < m.tile_waste_budget <= 1.0:
+        msgs.append(
+            f"model.tile_waste_budget must be in (0, 1], got "
+            f"{m.tile_waste_budget} — build_supports can never accept a "
+            "plan under it"
+        )
+        return msgs
+    sizes = (
+        list(n_nodes) if isinstance(n_nodes, (list, tuple)) else [n_nodes]
+    )
+    for city, n in enumerate(sizes):
+        padded = _ceil_to(max(int(n), 1), m.tile_size)
+        waste = 1.0 - n / padded
+        if waste > m.tile_waste_budget:
+            msgs.append(
+                f"city {city}: N={n} pads to {padded} on the "
+                f"tile_size={m.tile_size} grid — {waste:.3f} of every "
+                "stored block row is padding, already past "
+                f"tile_waste_budget={m.tile_waste_budget}; build_supports "
+                "is guaranteed to raise (shrink the tile or raise the "
+                "budget)"
+            )
+    est = tiled_spmm_vmem_estimate(m.tile_size)
+    from stmgcn_tpu.analysis.pallas_check import VMEM_BUDGET_BYTES
+
+    if est > VMEM_BUDGET_BYTES:
+        msgs.append(
+            f"tile_size={m.tile_size}: the tiled SpMM's streamed blocks "
+            f"estimate {est / (1 << 20):.2f} MiB of VMEM "
+            f"(calibrated model, tm={_TM_WORST} worst case) against the "
+            f"{VMEM_BUDGET_BYTES >> 20} MiB/core budget — Mosaic aborts "
+            "at this tile; 512 is the largest viable power of two"
+        )
+    return msgs
+
+
+def _city_nodes(cfg) -> List[int]:
+    d = cfg.data
+    cols = d.cols
+    if d.city_rows is not None:
+        return [r * (cols if cols is not None else r) for r in d.city_rows]
+    return [d.rows * (cols if cols is not None else d.rows)]
+
+
+def check_tile_plan(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+) -> List[Finding]:
+    """Validate every preset's tiled-support plan (no-op for untiled
+    presets). ``configs`` is ``(name, ExperimentConfig)`` pairs; default
+    is every registered preset. Pure config math — safe without a JAX
+    backend."""
+    from stmgcn_tpu.config import PRESETS
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="tile-plan",
+                path=f"<contract:tile-plan:{name}>",
+                line=0,
+                message=f"{name}: {message}",
+                severity=RULES["tile-plan"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        if not getattr(cfg.model, "tiled", False):
+            continue
+        if cfg.mesh.n_devices > 1:
+            emit(
+                name,
+                f"model.tiled on a {cfg.mesh.n_devices}-device mesh — "
+                "tiled plans are single-device artifacts and "
+                "route_supports rejects the combination",
+            )
+        for msg in tile_plan_violations(cfg.model, _city_nodes(cfg)):
+            emit(name, msg)
+    return findings

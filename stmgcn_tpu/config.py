@@ -132,6 +132,25 @@ class ModelConfig:
     #: route graph convolutions through the Pallas block-CSR SpMM (large
     #: sparse graphs); branches loop instead of vmapping
     sparse: bool = False
+    #: route graph convolutions through the offline-reordered tiled-sparse
+    #: path (ops/tiling.py): RCM-style node permutation + dense
+    #: (tile, tile) block condensation covering all M x K supports in one
+    #: plan, applied via gathered-tiles XLA or the fused Pallas SpMM.
+    #: The large-N representation — mutually exclusive with ``sparse``
+    #: and with multi-device meshes; branches loop instead of vmapping
+    tiled: bool = False
+    #: tiled-plan block edge; the ``tile-plan`` lint rule demands a
+    #: positive multiple of 128 (the MXU's native tile) that fits the
+    #: kernel's VMEM model
+    tile_size: int = 128
+    #: largest fraction of *stored* tile blocks the condensed plan may
+    #: waste on all-zero padding (the uniform block-column imposition) —
+    #: node-padding waste at config time (the ``tile-plan`` rule) and
+    #: realized zero-block condensation waste at plan time
+    #: (``build_supports`` raises past it). A graph whose nonzeros
+    #: refuse to cluster should fall back to dense/sparse, not silently
+    #: burn MXU cycles on zeros
+    tile_waste_budget: float = 0.75
     remat: bool = False
     #: LSTM scan scheduling (numerically identical, XLA-level levers):
     #: unroll factor for the time scan, and single-scan-all-layers fusion

@@ -156,15 +156,40 @@ def build_supports(cfg: ExperimentConfig, dataset: DemandDataset):
 
     Dense mode: one stacked ``(M, n_supports, N, N)`` array. Sparse mode:
     an M-tuple of :class:`~stmgcn_tpu.ops.spmm.BlockSparseStack` — each
-    branch's K supports in one fused-launch block-CSR structure. When the
+    branch's K supports in one fused-launch block-CSR structure. Tiled
+    mode: one :class:`~stmgcn_tpu.ops.tiling.TiledSupports` plan per city
+    (offline reorder + condense covering all M x K supports). When the
     dataset's cities carry differing graphs, the result is a
     :class:`~stmgcn_tpu.train.CitySupports` of one such stack per city.
     On a region mesh that does not divide ``N``, the node axes carry zero
     padding (see :func:`node_pad_target`).
     """
+    if cfg.model.tiled and cfg.model.sparse:
+        raise ValueError(
+            "model.tiled and model.sparse are mutually exclusive — each is "
+            "a complete support representation; pick one"
+        )
 
     def one(adjs):
         dense = _dense_supports(cfg, adjs)
+        if cfg.model.tiled:
+            from stmgcn_tpu.ops.tiling import plan_tiling
+
+            plan = plan_tiling(dense, tile=cfg.model.tile_size)
+            stats = plan.tile_stats()
+            stored = (
+                plan.m_graphs * plan.n_supports * plan.block_rows * plan.block_cols
+            )
+            waste = 1.0 - stats["blocks_kept"] / max(stored, 1)
+            if waste > cfg.model.tile_waste_budget:
+                raise ValueError(
+                    f"tiled condensation wastes {waste:.3f} of stored blocks "
+                    f"on all-zero padding (> model.tile_waste_budget="
+                    f"{cfg.model.tile_waste_budget}) — the graph's nonzeros "
+                    "do not cluster under the reorder; use dense/sparse "
+                    "supports, a smaller model.tile_size, or raise the budget"
+                )
+            return plan
         if not cfg.model.sparse:
             return dense
         from stmgcn_tpu.ops.spmm import stack_from_dense
@@ -214,6 +239,19 @@ def route_supports(cfg: ExperimentConfig, dataset: DemandDataset, supports=None)
       raises.
     """
     _strategy_active(cfg)  # validates strategy / branch-axis combinations
+    if cfg.model.tiled:
+        # tiled-sparse supports are a single-device representation: the
+        # gathered-tiles/Pallas kernels own the full node axis (the offline
+        # permutation has no sharded form), and branches run the loop
+        # layout — no vmapped branch axis for a mesh to shard
+        if cfg.mesh.n_devices > 1:
+            raise ValueError(
+                "model.tiled does not compose with a >1-device mesh — the "
+                "reordered tile plan owns the whole node axis; use dense "
+                "GSPMD or sharded sparse supports for multi-device configs"
+            )
+        supports = build_supports(cfg, dataset) if supports is None else supports
+        return supports, ("tiled",) * cfg.model.m_graphs
     if not dataset.shared_graphs and (
         (cfg.model.sparse and cfg.mesh.n_devices > 1) or _strategy_active(cfg)
     ):
@@ -323,9 +361,14 @@ def build_model(
     single-device rebuild (e.g. :class:`~stmgcn_tpu.inference.Forecaster`)
     reconstructs the same layout with plain dense supports. (Sparse mode
     uses the loop layout — except under ``mesh.branch > 1``, which is
-    vmapped like everything branch-parallel.)
+    vmapped like everything branch-parallel. Tiled mode always uses the
+    loop layout: ``support_modes=("tiled",) * M`` is derived from the
+    config here whenever the caller passed none, so a checkpoint rebuild
+    without :func:`route_supports` still gets the trained layout.)
     """
     m = cfg.model
+    if m.tiled and support_modes is None:
+        support_modes = ("tiled",) * m.m_graphs
     return STMGCN(
         m_graphs=m.m_graphs,
         n_supports=m.n_supports,

@@ -4,6 +4,7 @@ from stmgcn_tpu.models.cg_lstm import CGLSTM, ContextualGate
 from stmgcn_tpu.models.params import (
     to_dense_serving,
     to_looped_params,
+    to_tiled_serving,
     to_vmapped_params,
 )
 from stmgcn_tpu.models.st_mgcn import STMGCN, Branch
@@ -15,5 +16,6 @@ __all__ = [
     "STMGCN",
     "to_dense_serving",
     "to_looped_params",
+    "to_tiled_serving",
     "to_vmapped_params",
 ]

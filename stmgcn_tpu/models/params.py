@@ -22,7 +22,12 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-__all__ = ["to_dense_serving", "to_looped_params", "to_vmapped_params"]
+__all__ = [
+    "to_dense_serving",
+    "to_looped_params",
+    "to_tiled_serving",
+    "to_vmapped_params",
+]
 
 _VMAPPED_KEY = "branches"
 
@@ -71,6 +76,32 @@ def to_dense_serving(model, variables, m_graphs: int):
             n_real_nodes=None,
         )
         variables = to_vmapped_params(variables, m_graphs)
+    if model.lstm_backend != "xla":
+        model = dataclasses.replace(model, lstm_backend="xla", lstm_pallas_mesh=None)
+    return model, variables
+
+
+def to_tiled_serving(model, variables, m_graphs: int):
+    """Rebuild ``(model, params)`` as the tiled-sparse serving clone.
+
+    The tiled twin of :func:`to_dense_serving`: serving a large-N city
+    on its :class:`~stmgcn_tpu.ops.tiling.TiledSupports` plan needs the
+    loop-layout model with ``support_modes=("tiled",) * M`` — a
+    dense/vmapped-trained checkpoint is unstacked to ``branch_0..
+    branch_{M-1}``; sparse/banded/tiled-trained (already looped)
+    checkpoints pass through. Shard bindings drop and a Pallas-backend
+    LSTM re-routes to the xla scan, exactly like the dense clone.
+    """
+    if all(mode == "dense" for mode in model.branch_modes()) and model.vmap_branches:
+        variables = to_looped_params(variables, m_graphs)
+    model = dataclasses.replace(
+        model,
+        sparse=False,
+        support_modes=("tiled",) * m_graphs,
+        shard_spec=None,
+        vmap_branches=False,
+        n_real_nodes=None,
+    )
     if model.lstm_backend != "xla":
         model = dataclasses.replace(model, lstm_backend="xla", lstm_pallas_mesh=None)
     return model, variables

@@ -1,6 +1,10 @@
 """Numeric ops: graph-support builders, graph convolution, recurrence, kernels."""
 
-from stmgcn_tpu.ops.chebconv import ChebGraphConv, SparseChebGraphConv
+from stmgcn_tpu.ops.chebconv import (
+    ChebGraphConv,
+    SparseChebGraphConv,
+    TiledChebGraphConv,
+)
 from stmgcn_tpu.ops.graph import (
     SupportConfig,
     build_supports,
@@ -16,12 +20,16 @@ from stmgcn_tpu.ops.graph import (
     symmetric_normalize,
 )
 from stmgcn_tpu.ops.lstm import StackedLSTM
+from stmgcn_tpu.ops.tiling import TiledSupports, plan_tiling
 
 __all__ = [
     "ChebGraphConv",
     "SparseChebGraphConv",
     "StackedLSTM",
     "SupportConfig",
+    "TiledChebGraphConv",
+    "TiledSupports",
+    "plan_tiling",
     "build_supports",
     "chebyshev_polynomials",
     "chebyshev_supports",

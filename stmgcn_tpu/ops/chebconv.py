@@ -21,20 +21,28 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["BandedChebGraphConv", "ChebGraphConv", "SparseChebGraphConv", "conv_cls", "make_conv"]
+__all__ = [
+    "BandedChebGraphConv",
+    "ChebGraphConv",
+    "SparseChebGraphConv",
+    "TiledChebGraphConv",
+    "conv_cls",
+    "make_conv",
+]
 
 
 def conv_cls(mode):
     """The graph-conv class for a support representation (one mapping, shared
     by every call site that dispatches on support mode). ``mode`` is
-    ``"dense" | "sparse" | "banded"`` (bools accepted for back-compat:
-    ``True`` = sparse, ``False`` = dense)."""
+    ``"dense" | "sparse" | "banded" | "tiled"`` (bools accepted for
+    back-compat: ``True`` = sparse, ``False`` = dense)."""
     if isinstance(mode, bool):
         mode = "sparse" if mode else "dense"
     classes = {
         "dense": ChebGraphConv,
         "sparse": SparseChebGraphConv,
         "banded": BandedChebGraphConv,
+        "tiled": TiledChebGraphConv,
     }
     if mode not in classes:
         raise ValueError(f"support mode must be one of {sorted(classes)}, got {mode!r}")
@@ -233,3 +241,83 @@ class BandedChebGraphConv(nn.Module):
             batch, n_nodes, self.n_supports * f_in
         )
         return _project(stacked, w, b, self.activation)
+
+
+class TiledChebGraphConv(nn.Module):
+    """Graph convolution over reorder/condensed tiled-sparse supports.
+
+    Same parameters and math as :class:`ChebGraphConv` (identical param
+    names/shapes — trained weights are interchangeable), consuming one
+    branch of an offline :class:`~stmgcn_tpu.ops.tiling.TiledSupports`
+    plan (:class:`~stmgcn_tpu.ops.tiling.TiledBranchSupports`). The
+    signal is permuted INTO the plan's bandwidth-reduced node order once
+    at the boundary, all K propagations run over kept ``(tile, tile)``
+    blocks only, and the projected output permutes back out — the
+    permutation never touches the contraction itself.
+
+    Two numerically-matching block paths, selected by ``backend``:
+
+    - ``"xla"`` — gathered-tiles: ``jnp.take`` of signal row blocks by
+      the block-column index lists + one batched tile matmul with f32
+      accumulation (:func:`~stmgcn_tpu.ops.tiling.gathered_tiles_apply`).
+      Runs (and is measurable) anywhere, including the CPU host.
+    - ``"pallas"`` — the fused block-CSR ``spmm_stack`` kernel from
+      :mod:`stmgcn_tpu.ops.spmm`, reused verbatim through
+      :meth:`~stmgcn_tpu.ops.tiling.TiledBranchSupports.as_stack`.
+    - ``"auto"`` (default) — pallas on a real TPU, xla elsewhere.
+    """
+
+    n_supports: int
+    features: int
+    backend: str = "auto"
+    use_bias: bool = True
+    activation: Optional[Callable] = nn.relu
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, supports, x: jnp.ndarray) -> jnp.ndarray:
+        import jax
+
+        from stmgcn_tpu.ops.spmm import spmm_stack
+        from stmgcn_tpu.ops.tiling import TiledBranchSupports, gathered_tiles_apply
+
+        if not isinstance(supports, TiledBranchSupports):
+            raise TypeError(
+                "tiled mode consumes TiledBranchSupports (one branch of a "
+                f"plan_tiling artifact), got {type(supports).__name__}"
+            )
+        if supports.n_supports != self.n_supports:
+            raise ValueError(
+                f"expected {self.n_supports} supports, got {supports.n_supports}"
+            )
+        backend = self.backend
+        if backend == "auto":
+            backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"backend must be auto|xla|pallas, got {self.backend!r}"
+            )
+        batch, n_nodes, f_in = x.shape
+        if n_nodes != supports.n:
+            raise ValueError(f"x has {n_nodes} nodes, plan expects {supports.n}")
+        w, b = _conv_params(self, f_in)
+        x, w, b = nn.dtypes.promote_dtype(x, w, b, dtype=self.dtype)
+
+        # (B, N, F) -> (N, B*F), then ONE permute into the plan's order
+        x_mat = x.transpose(1, 0, 2).reshape(n_nodes, batch * f_in)
+        x_mat = jnp.take(x_mat, supports.perm, axis=0)
+        if backend == "pallas":
+            propagated = spmm_stack(supports.as_stack(), x_mat)
+        else:
+            propagated = gathered_tiles_apply(supports, x_mat)
+        propagated = propagated.astype(x.dtype)  # f32 accumulate -> compute dtype
+        # (K, N, B*F) -> (B, N, K*F), k-major to match the dense layout
+        stacked = (
+            propagated.reshape(self.n_supports, n_nodes, batch, f_in)
+            .transpose(2, 1, 0, 3)
+            .reshape(batch, n_nodes, self.n_supports * f_in)
+        )
+        out = _project(stacked, w, b, self.activation)
+        # permute the node axis back out AFTER the (node-wise) projection
+        return jnp.take(out, supports.inv, axis=1)

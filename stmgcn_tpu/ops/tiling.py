@@ -1,0 +1,390 @@
+"""Offline tiled-sparse support planning: reorder + condense for the MXU.
+
+Large-N cities (a 10k+-region metro) hit the dense-FLOP ceiling of the
+``(M, K, N, N)`` support stack long before the hardware's: a Chebyshev
+support of a metro road/grid graph is overwhelmingly zero, but the MXU
+only eats dense tiles. The TC-GNN / "sparse GNNs on dense hardware"
+recipe (PAPERS.md) fixes the mismatch **offline**:
+
+1. **Reorder** — a bandwidth-reducing node permutation (reverse
+   Cuthill-McKee-style BFS over the symmetrized union pattern of all
+   M x K supports) clusters each row's neighbors, so nonzeros land in
+   few ``(tile, tile)`` blocks instead of being scattered across a row.
+2. **Condense** — pack each permuted support's nonzero blocks into a
+   uniform block-CSR layout (``ops/spmm.py``'s representation), one
+   common block-column count across all M x K supports of the city so
+   every kernel operand shape is static.
+
+The result is a :class:`TiledSupports` artifact covering the whole city
+in one plan: permutation + inverse, per-support block data/index stacks
+(forward and pre-transposed for the backward pass), with
+:meth:`TiledSupports.tile_stats` reporting blocks-kept vs
+blocks-dense-equivalent — the density ratio that bounds the FLOP win.
+
+Everything here is **numpy on the host** (an offline preprocessing
+pass); the online apply lives in
+:class:`stmgcn_tpu.ops.chebconv.TiledChebGraphConv`, which permutes the
+signal in once, runs either the gathered-tiles XLA path
+(:func:`gathered_tiles_apply`) or the fused Pallas ``spmm_stack``
+kernel, and permutes the final stack back out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stmgcn_tpu.ops.spmm import (
+    TILE,
+    BlockSparseStack,
+    _assemble_blocks,
+    _ceil_to,
+    _scan_blocks,
+)
+
+__all__ = [
+    "TiledBranchSupports",
+    "TiledSupports",
+    "gathered_tiles_apply",
+    "plan_tiling",
+    "rcm_permutation",
+]
+
+
+def rcm_permutation(pattern: np.ndarray) -> np.ndarray:
+    """Reverse-Cuthill-McKee-style BFS ordering of a sparsity pattern.
+
+    ``pattern`` is a boolean ``(N, N)`` adjacency (symmetrized inside —
+    bandwidth is a property of the symmetric closure). Components are
+    seeded from their minimum-degree node and BFS levels visit neighbors
+    in ascending-degree order; the final order is reversed (the RCM
+    refinement — same bandwidth, better profile). Pure numpy, no scipy.
+
+    Returns ``perm`` (int32): new position ``p`` holds original node
+    ``perm[p]``, i.e. ``A_reordered = A[perm][:, perm]``.
+    """
+    pattern = np.asarray(pattern)
+    if pattern.ndim != 2 or pattern.shape[0] != pattern.shape[1]:
+        raise ValueError(f"pattern must be square (N, N), got {pattern.shape}")
+    sym = (pattern != 0) | (pattern.T != 0)
+    np.fill_diagonal(sym, False)
+    n = sym.shape[0]
+    deg = sym.sum(axis=1)
+    order = np.empty(n, dtype=np.int64)
+    visited = np.zeros(n, dtype=bool)
+    pos = 0
+    for start in np.argsort(deg, kind="stable"):
+        if visited[start]:
+            continue
+        visited[start] = True
+        order[pos] = start
+        head, pos = pos, pos + 1
+        while head < pos:
+            u = order[head]
+            head += 1
+            nbrs = np.flatnonzero(sym[u] & ~visited)
+            if nbrs.size:
+                nbrs = nbrs[np.argsort(deg[nbrs], kind="stable")]
+                visited[nbrs] = True
+                order[pos : pos + nbrs.size] = nbrs
+                pos += nbrs.size
+    return order[::-1].astype(np.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TiledBranchSupports:
+    """One branch's slice of a :class:`TiledSupports` plan (K supports).
+
+    What the per-branch graph conv consumes: the city permutation plus
+    this branch's uniform block-CSR stacks. :meth:`as_stack` views the
+    blocks as an :class:`~stmgcn_tpu.ops.spmm.BlockSparseStack` so the
+    fused Pallas kernel path is shared verbatim with sparse mode.
+    """
+
+    perm: jnp.ndarray  # (N,) int32 — x_reordered = x[perm]
+    inv: jnp.ndarray  # (N,) int32 — y = y_reordered[inv]
+    data: jnp.ndarray  # (K, R, C, tile, tile) f32
+    idx: jnp.ndarray  # (K, R, C) int32
+    data_t: jnp.ndarray  # (K, R, C_t, tile, tile) f32
+    idx_t: jnp.ndarray  # (K, R, C_t) int32
+    n: int
+    tile: int
+
+    def tree_flatten(self):
+        return (
+            self.perm, self.inv, self.data, self.idx, self.data_t, self.idx_t,
+        ), (self.n, self.tile)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        perm, inv, data, idx, data_t, idx_t = children
+        n, tile = aux
+        return cls(perm=perm, inv=inv, data=data, idx=idx, data_t=data_t,
+                   idx_t=idx_t, n=n, tile=tile)
+
+    @property
+    def n_supports(self) -> int:
+        return self.data.shape[0]
+
+    def as_stack(self) -> BlockSparseStack:
+        """This branch's blocks as the fused-kernel operand (square N x N
+        in the *permuted* node order — callers permute the signal)."""
+        return BlockSparseStack(
+            data=self.data, idx=self.idx, data_t=self.data_t,
+            idx_t=self.idx_t, n_rows=self.n, n_cols=self.n, tile=self.tile,
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TiledSupports:
+    """One city's tiled-sparse support plan: all M graphs x K supports.
+
+    ``data``/``idx`` carry a leading ``(M, K, ...)`` pair with ONE common
+    block-column count across every support (and one for the transposes),
+    so per-city plans tree-stack into fleet class operands and the scan
+    bodies' per-slot ``jnp.take`` works leaf-wise. Indexing (``plan[m]``)
+    yields the branch view the per-branch conv loop consumes, mirroring
+    how the sparse M-tuple is consumed.
+
+    Aux data is ``(n, tile)`` only — occupancy accounting is derived on
+    demand (:meth:`tile_stats`), never stored, so two cities' plans with
+    equal shapes are the *same* pytree structure.
+    """
+
+    perm: jnp.ndarray  # (N,) int32
+    inv: jnp.ndarray  # (N,) int32
+    data: jnp.ndarray  # (M, K, R, C, tile, tile) f32
+    idx: jnp.ndarray  # (M, K, R, C) int32
+    data_t: jnp.ndarray  # (M, K, R, C_t, tile, tile) f32
+    idx_t: jnp.ndarray  # (M, K, R, C_t) int32
+    n: int
+    tile: int
+
+    def tree_flatten(self):
+        return (
+            self.perm, self.inv, self.data, self.idx, self.data_t, self.idx_t,
+        ), (self.n, self.tile)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        perm, inv, data, idx, data_t, idx_t = children
+        n, tile = aux
+        return cls(perm=perm, inv=inv, data=data, idx=idx, data_t=data_t,
+                   idx_t=idx_t, n=n, tile=tile)
+
+    @property
+    def m_graphs(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def n_supports(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def block_rows(self) -> int:
+        return self.data.shape[2]
+
+    @property
+    def block_cols(self) -> int:
+        return self.data.shape[3]
+
+    @property
+    def ndim(self) -> int:
+        # deliberately NOT 4: every "is this a dense (M, K, N, N) stack"
+        # gate in the trainer/serving paths keys off ndim == 4
+        return 0
+
+    @property
+    def nbytes(self) -> int:
+        return (self.data.nbytes + self.idx.nbytes + self.data_t.nbytes
+                + self.idx_t.nbytes)
+
+    def __len__(self) -> int:
+        # the model's non-dense loop path does len(supports_stack) and
+        # supports_stack[m] — same protocol as the sparse M-tuple
+        return self.m_graphs
+
+    def __getitem__(self, m: int) -> TiledBranchSupports:
+        if not isinstance(m, (int, np.integer)):
+            raise TypeError(f"branch index must be an int, got {type(m)!r}")
+        return TiledBranchSupports(
+            perm=self.perm, inv=self.inv, data=self.data[m], idx=self.idx[m],
+            data_t=self.data_t[m], idx_t=self.idx_t[m], n=self.n,
+            tile=self.tile,
+        )
+
+    def tile_stats(self) -> dict:
+        """Occupancy accounting (host-side: reads block values).
+
+        ``blocks_kept`` counts truly-nonzero forward blocks;
+        ``blocks_dense_equivalent`` is what a dense padded plan would
+        carry (``M * K * R * R``); their ratio is the density that bounds
+        the support-apply FLOP win (``flops_ratio`` uses the *stored*
+        ``C / R`` — what the kernels actually execute, padding included).
+        """
+        data = np.asarray(self.data)
+        r = self.block_rows
+        kept = int(np.any(data != 0.0, axis=(-1, -2)).sum())
+        dense_eq = self.m_graphs * self.n_supports * r * r
+        return {
+            "n": self.n,
+            "tile": self.tile,
+            "block_rows": r,
+            "block_cols": self.block_cols,
+            "blocks_kept": kept,
+            "blocks_dense_equivalent": dense_eq,
+            "density": kept / dense_eq,
+            "flops_ratio": self.block_cols / r,
+            "nbytes": int(self.nbytes),
+            "dense_nbytes": int(
+                self.m_graphs * self.n_supports * self.n * self.n * 4
+            ),
+        }
+
+    def pad_to(self, n_new: int) -> "TiledSupports":
+        """Grow to a rung of ``n_new`` nodes (fleet shape classes).
+
+        New nodes are isolated: identity-tail permutation, and zero
+        block rows once the rung crosses a tile boundary (index 0 with
+        zero data — the same harmless-padding convention as
+        ``ops/spmm.py``).
+        """
+        if n_new < self.n:
+            raise ValueError(f"cannot shrink a plan: n={self.n} -> {n_new}")
+        if n_new == self.n:
+            return self
+        r_new = _ceil_to(n_new, self.tile) // self.tile
+        grow = r_new - self.block_rows
+        perm = jnp.concatenate(
+            [self.perm, jnp.arange(self.n, n_new, dtype=jnp.int32)]
+        )
+        inv = jnp.concatenate(
+            [self.inv, jnp.arange(self.n, n_new, dtype=jnp.int32)]
+        )
+
+        def pad_r(a):
+            widths = [(0, 0)] * a.ndim
+            widths[2] = (0, grow)
+            return jnp.pad(a, widths)
+
+        return TiledSupports(
+            perm=perm, inv=inv,
+            data=pad_r(self.data), idx=pad_r(self.idx),
+            data_t=pad_r(self.data_t), idx_t=pad_r(self.idx_t),
+            n=n_new, tile=self.tile,
+        )
+
+    def with_block_cols(self, c: int, c_t: int) -> "TiledSupports":
+        """Pad the block-column axes to imposed widths (fleet classes
+        stack member plans leaf-wise, which needs one common ``C``)."""
+        if c < self.block_cols or c_t < self.data_t.shape[3]:
+            raise ValueError(
+                f"cannot narrow block columns: ({self.block_cols}, "
+                f"{self.data_t.shape[3]}) -> ({c}, {c_t})"
+            )
+
+        def pad_c(a, width):
+            widths = [(0, 0)] * a.ndim
+            widths[3] = (0, width - a.shape[3])
+            return jnp.pad(a, widths)
+
+        return TiledSupports(
+            perm=self.perm, inv=self.inv,
+            data=pad_c(self.data, c), idx=pad_c(self.idx, c),
+            data_t=pad_c(self.data_t, c_t), idx_t=pad_c(self.idx_t, c_t),
+            n=self.n, tile=self.tile,
+        )
+
+
+def plan_tiling(dense, tile: int = TILE) -> TiledSupports:
+    """Plan one city's tiled supports from its dense ``(M, K, N, N)`` stack.
+
+    Offline, numpy-only: RCM-style permutation over the symmetrized union
+    pattern of all M x K supports (one ordering for the whole city — the
+    signal permutes once, not per branch), then block condensation of
+    each permuted support at one common block-column count.
+    """
+    dense = np.asarray(dense, dtype=np.float32)
+    if dense.ndim != 4 or dense.shape[2] != dense.shape[3]:
+        raise ValueError(
+            f"supports must be dense (M, K, N, N), got {dense.shape}"
+        )
+    if tile < 1:
+        raise ValueError(f"tile must be >= 1, got {tile}")
+    m_graphs, k, n, _ = dense.shape
+    union = np.any(dense != 0.0, axis=(0, 1))
+    perm = rcm_permutation(union)
+    inv = np.argsort(perm).astype(np.int32)
+    permuted = dense[:, :, perm][:, :, :, perm]
+
+    fwd_scan = [
+        [_scan_blocks(permuted[mi, ki], tile) for ki in range(k)]
+        for mi in range(m_graphs)
+    ]
+    bwd_scan = [
+        [
+            _scan_blocks(np.ascontiguousarray(permuted[mi, ki].T), tile)
+            for ki in range(k)
+        ]
+        for mi in range(m_graphs)
+    ]
+
+    def width(scans):
+        return max(
+            max(int(nz.sum(axis=1).max()), 1)
+            for row in scans for _, nz in row
+        )
+
+    c_max, c_max_t = width(fwd_scan), width(bwd_scan)
+
+    def assemble(scans, c):
+        data = np.stack([
+            np.stack([_assemble_blocks(b, nz, c, tile)[0] for b, nz in row])
+            for row in scans
+        ])
+        idx = np.stack([
+            np.stack([_assemble_blocks(b, nz, c, tile)[1] for b, nz in row])
+            for row in scans
+        ])
+        return data, idx
+
+    data, idx = assemble(fwd_scan, c_max)
+    data_t, idx_t = assemble(bwd_scan, c_max_t)
+    return TiledSupports(
+        perm=jnp.asarray(perm), inv=jnp.asarray(inv),
+        data=jnp.asarray(data), idx=jnp.asarray(idx),
+        data_t=jnp.asarray(data_t), idx_t=jnp.asarray(idx_t),
+        n=n, tile=tile,
+    )
+
+
+def gathered_tiles_apply(branch: TiledBranchSupports, x_mat: jnp.ndarray) -> jnp.ndarray:
+    """``out[k] = A_k @ x`` through pure gather + batched matmul XLA ops.
+
+    The off-chip twin of the Pallas ``spmm_stack`` path: ``jnp.take`` of
+    the signal's row blocks by the block-column index lists, one batched
+    ``(tile, tile) @ (tile, BF)`` contraction per kept block, f32
+    accumulation (``preferred_element_type`` mirrors the kernel's MXU
+    accumulate). Measurable on the 1-core CPU-fallback host, where
+    interpret-mode Pallas is orders of magnitude off. ``x_mat`` is the
+    *permuted* ``(N, BF)`` signal; returns ``(K, N, BF)`` f32. Gradients
+    flow to ``x_mat`` only in practice (supports are never params), via
+    the transpose of gather — no dense ``(N, N)`` form is ever built.
+    """
+    k, r, c = branch.idx.shape
+    tile = branch.tile
+    n_pad = r * tile
+    x_pad = jnp.zeros((n_pad, x_mat.shape[1]), x_mat.dtype)
+    x_pad = x_pad.at[: x_mat.shape[0]].set(x_mat)
+    x_blocks = x_pad.reshape(r, tile, x_mat.shape[1])
+    gathered = jnp.take(x_blocks, branch.idx, axis=0)  # (K, R, C, tile, BF)
+    out = jnp.einsum(
+        "krcij,krcjf->krif", branch.data, gathered,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(k, n_pad, x_mat.shape[1])[:, : branch.n]

@@ -322,8 +322,16 @@ class ServingEngine:
         :class:`~stmgcn_tpu.resilience.ServeFaultPlan` through the
         batcher and checkpoint watcher (tests only; the empty plan is a
         no-op).
+
+        A :class:`~stmgcn_tpu.ops.tiling.TiledSupports` plan instead of a
+        dense stack builds the *tiled* serving clone
+        (``models.to_tiled_serving``): the large-N path, where the dense
+        ``(M, K, N, N)`` stack would not even be worth materializing on
+        device. Same engine contract — AOT rungs, resident supports,
+        hot-swappable params (swaps go through the tiled transform).
         """
-        from stmgcn_tpu.models import to_dense_serving
+        from stmgcn_tpu.models import to_dense_serving, to_tiled_serving
+        from stmgcn_tpu.ops.tiling import TiledSupports
 
         cfg = cls._resolve_config(
             config if config is not None else getattr(fc.config, "serving", None)
@@ -348,11 +356,23 @@ class ServingEngine:
             )
 
         m = fc.config.model.m_graphs
-        model, params = to_dense_serving(fc.model, fc.params, m)
-        supports_np = cls._check_supports(
-            supports, (m, model.n_supports, n_nodes, n_nodes)
-        )
-        sup_dev = jax.device_put(jnp.asarray(supports_np))
+        tiled = isinstance(supports, TiledSupports)
+        if tiled:
+            model, params = to_tiled_serving(fc.model, fc.params, m)
+            got = (supports.m_graphs, supports.n_supports, supports.n)
+            want = (m, model.n_supports, n_nodes)
+            if got != want:
+                raise ValueError(
+                    f"tiled supports must plan (M, K, N)={want}, got {got}"
+                )
+            supports_np = supports  # the plan IS the host-side artifact
+            sup_dev = jax.device_put(supports)
+        else:
+            model, params = to_dense_serving(fc.model, fc.params, m)
+            supports_np = cls._check_supports(
+                supports, (m, model.n_supports, n_nodes, n_nodes)
+            )
+            sup_dev = jax.device_put(jnp.asarray(supports_np))
         params_dev = jax.tree.map(jnp.asarray, params)
         expected = (fc.seq_len, n_nodes, fc.derived["input_dim"])
         fn = serve_bucket_fn(model)
@@ -370,9 +390,13 @@ class ServingEngine:
         engine = cls(programs, sup_dev, supports_np, normalizer, expected,
                      cfg, params_dev=params_dev, fault_plan=fault_plan)
         # hot-swap plumbing: raw checkpoint params go through the same
-        # dense-serving transform the ladder was compiled for, and
-        # verified loads restore against the live checkpoint's pytree
-        engine._prepare_params = lambda p: to_dense_serving(fc.model, p, m)[1]
+        # serving transform the ladder was compiled for, and verified
+        # loads restore against the live checkpoint's pytree
+        engine._prepare_params = (
+            (lambda p: to_tiled_serving(fc.model, p, m)[1])
+            if tiled
+            else (lambda p: to_dense_serving(fc.model, p, m)[1])
+        )
         engine._params_template = fc.params
         hb = getattr(fc, "health_baseline", None)
         hcfg = getattr(fc.config, "health", None)

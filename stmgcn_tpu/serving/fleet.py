@@ -59,7 +59,7 @@ from stmgcn_tpu.serving.engine import (
 from stmgcn_tpu.serving.metrics import EngineStats
 from stmgcn_tpu.serving.microbatch import MicroBatcher
 
-__all__ = ["FleetServingEngine", "fleet_bucket_fn"]
+__all__ = ["FleetServingEngine", "fleet_bucket_fn", "fleet_tiled_bucket_fn"]
 
 
 def fleet_bucket_fn(model):
@@ -83,6 +83,27 @@ def fleet_bucket_fn(model):
         return jax.vmap(row)(history, slots)
 
     return serve_fleet_bucket
+
+
+def fleet_tiled_bucket_fn(tiled_model, m_graphs: int):
+    """The private-class serving program for a tiled (large-N) city.
+
+    Tiled cities always serve exact-fit (their
+    :class:`~stmgcn_tpu.ops.tiling.TiledSupports` plan owns the whole
+    reordered node axis — rung-sharing would mean re-planning at the
+    rung), so there is no slot gather. The fleet's single vmapped
+    ``(generation, params)`` reference still covers this class: the
+    program converts to the loop layout *inside* (pure tree slicing,
+    traced once at AOT time), so one ``swap_params`` re-points tiled and
+    dense classes alike. Traced by the jaxpr contract pass as
+    ``serve_tiled_bucket``.
+    """
+    from stmgcn_tpu.models import to_looped_params
+
+    def serve_tiled_bucket(params, plan, history):
+        return tiled_model.apply(to_looped_params(params, m_graphs), plan, history)
+
+    return serve_tiled_bucket
 
 
 class FleetServingEngine:
@@ -176,9 +197,17 @@ class FleetServingEngine:
         every (class, batch-bucket) pair compiled AOT with the class's
         rung-padded support stack pinned device-resident and parameters
         an explicit (hot-swappable) argument.
+
+        Cities whose entry is a :class:`~stmgcn_tpu.ops.tiling
+        .TiledSupports` plan (the large-N tiled path) each get a private
+        exact-fit class running the tiled serving clone
+        (:func:`fleet_tiled_bucket_fn`) — they never rung-share, but
+        they DO share the fleet's single param reference, checkpoint
+        watcher, and SLO machinery.
         """
         from stmgcn_tpu.data.fleet import plan_shape_classes
-        from stmgcn_tpu.models import to_dense_serving
+        from stmgcn_tpu.models import to_dense_serving, to_tiled_serving
+        from stmgcn_tpu.ops.tiling import TiledSupports
 
         cfg = ServingEngine._resolve_config(
             config if config is not None else getattr(fc.config, "serving", None)
@@ -202,8 +231,21 @@ class FleetServingEngine:
             )
         m = fc.config.model.m_graphs
         model, params = to_dense_serving(fc.model, fc.params, m)
+        tiled_cities = frozenset(
+            c for c, s in enumerate(sups) if isinstance(s, TiledSupports)
+        )
         sups_np = []
         for c, (s, n) in enumerate(zip(sups, n_nodes)):
+            if c in tiled_cities:
+                got = (s.m_graphs, s.n_supports, s.n)
+                want = (m, model.n_supports, n)
+                if got != want:
+                    raise ValueError(
+                        f"city {c} tiled supports must plan (M, K, N)="
+                        f"{want}, got {got}"
+                    )
+                sups_np.append(s)
+                continue
             s = np.asarray(s, dtype=np.float32)
             want = (m, model.n_supports, n, n)
             if s.shape != want:
@@ -214,15 +256,44 @@ class FleetServingEngine:
         plan = plan_shape_classes(
             n_nodes, max_classes=max_classes, max_pad_waste=max_pad_waste
         )
-        groups = [(sc.n_nodes, tuple(sc.cities)) for sc in plan.classes]
+        groups = []
+        for sc in plan.classes:
+            dense_members = tuple(c for c in sc.cities if c not in tiled_cities)
+            if dense_members:
+                groups.append((sc.n_nodes, dense_members))
         for c in plan.unassigned:  # serve everyone: private exact-fit class
+            if c not in tiled_cities:
+                groups.append((n_nodes[c], (c,)))
+        for c in sorted(tiled_cities):  # tiled: always private, always exact
             groups.append((n_nodes[c], (c,)))
 
         params_dev = jax.tree.map(jnp.asarray, params)
         fn = fleet_bucket_fn(model)
+        fn_tiled = None
         seq_len, input_dim = fc.seq_len, fc.derived["input_dim"]
         programs: dict = {}
         for ci, (rung, cities) in enumerate(groups):
+            if cities[0] in tiled_cities:
+                if fn_tiled is None:
+                    fn_tiled = fleet_tiled_bucket_fn(
+                        to_tiled_serving(model, params, m)[0], m
+                    )
+                plan_dev = jax.device_put(sups_np[cities[0]])
+                programs[ci] = {}
+                for b in cfg.buckets:
+                    hist_struct = jax.ShapeDtypeStruct(
+                        (b, seq_len, rung, input_dim), jnp.float32
+                    )
+                    compiled = (
+                        jax.jit(fn_tiled)
+                        .lower(params_dev, plan_dev, hist_struct)
+                        .compile()
+                    )
+                    programs[ci][b] = (
+                        lambda p, slots, h, c_=compiled, pd=plan_dev:
+                        c_(p, pd, h)
+                    )
+                continue
             stack = np.zeros(
                 (len(cities), m, model.n_supports, rung, rung), np.float32
             )

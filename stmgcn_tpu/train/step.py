@@ -639,7 +639,14 @@ def make_fleet_superstep_fns(
         def body(carry, step_inputs):
             params, opt_state = carry
             idx, mask, slot, n_real = step_inputs
-            supports = jnp.take(supports_stack, slot, axis=0)
+            # leaf-wise slot select: for the dense (n_members, M, K, N, N)
+            # stack this is exactly the old jnp.take; a pytree support
+            # representation (e.g. a tiled-supports class stack) rides the
+            # same scan body — the supports are a per-slot *representation*,
+            # not a scheduler concern
+            supports = jax.tree.map(
+                lambda a: jnp.take(a, slot, axis=0), supports_stack
+            )
             x, y = gather_window_batch(series, targets, offsets, idx, horizon)
             if health:
                 params, opt_state, loss_val, grads, updates, prev = (
@@ -648,7 +655,7 @@ def make_fleet_superstep_fns(
                     )
                 )
                 stats = _health_stats(prev, grads, updates, loss_val)
-                n_members = supports_stack.shape[0]
+                n_members = jax.tree.leaves(supports_stack)[0].shape[0]
                 stats["city_loss"] = (
                     jax.nn.one_hot(slot, n_members, dtype=jnp.float32)
                     * loss_val

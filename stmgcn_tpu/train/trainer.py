@@ -529,6 +529,7 @@ class Trainer:
         self.fleet_max_pad_waste = fleet_max_pad_waste
         want_fleet = fleet is True or (fleet is None and steps_per_superstep > 1)
         fleet_blocker = None
+        fleet_tiled = False
         if not hetero:
             fleet_blocker = (
                 "the dataset is homogeneous (one shared graph fuses already)"
@@ -537,11 +538,25 @@ class Trainer:
             fleet_blocker = (
                 "data placement is not resident (stream/mesh upload per batch)"
             )
-        elif not (
-            isinstance(self.supports, CitySupports)
-            and all(getattr(s, "ndim", None) == 4 for s in self.supports.per_city)
-        ):
-            fleet_blocker = "per-city supports are not dense (M, K, N, N) stacks"
+        else:
+            from stmgcn_tpu.ops.tiling import TiledSupports
+
+            per_city = (
+                self.supports.per_city
+                if isinstance(self.supports, CitySupports)
+                else ()
+            )
+            fleet_tiled = bool(per_city) and all(
+                isinstance(s, TiledSupports) for s in per_city
+            )
+            if not per_city or not (
+                fleet_tiled
+                or all(getattr(s, "ndim", None) == 4 for s in per_city)
+            ):
+                fleet_blocker = (
+                    "per-city supports are neither dense (M, K, N, N) stacks "
+                    "nor uniformly tiled (TiledSupports) plans"
+                )
         if fleet is True and fleet_blocker is not None:
             raise ValueError(f"fleet=True cannot engage: {fleet_blocker}")
         if want_fleet and fleet_blocker is None:
@@ -562,16 +577,31 @@ class Trainer:
                 for slot, c in enumerate(cls.cities):
                     n = dataset.city_n_nodes[c]
                     new_pads[c] = cls.n_nodes - n
-                    grow = cls.n_nodes - new_sup[c].shape[-1]
-                    if grow:  # zero node rows/cols up to the rung
-                        new_sup[c] = jnp.pad(
-                            new_sup[c], [(0, 0), (0, 0), (0, grow), (0, grow)]
-                        )
+                    if fleet_tiled:
+                        # identity-tail permutation + zero block rows up to
+                        # the rung; block columns are unified per class below
+                        # so the class's plans tree-stack into one operand
+                        new_sup[c] = new_sup[c].pad_to(cls.n_nodes)
+                    else:
+                        grow = cls.n_nodes - new_sup[c].shape[-1]
+                        if grow:  # zero node rows/cols up to the rung
+                            new_sup[c] = jnp.pad(
+                                new_sup[c], [(0, 0), (0, 0), (0, grow), (0, grow)]
+                            )
                     self._fleet_cities[c] = _FleetCity(
                         cls=ci, slot=slot, rung=cls.n_nodes, n_real=n,
                         pad=cls.n_nodes - n, t_offset=t_off,
                     )
                     t_off += dataset.series(c).shape[0]
+                if fleet_tiled and cls.cities:
+                    c_common = max(new_sup[c].block_cols for c in cls.cities)
+                    c_t_common = max(
+                        new_sup[c].data_t.shape[3] for c in cls.cities
+                    )
+                    for c in cls.cities:
+                        new_sup[c] = new_sup[c].with_block_cols(
+                            c_common, c_t_common
+                        )
             self._node_pads = tuple(new_pads)
             self.node_pad = (
                 self._node_pads[0]
@@ -1314,12 +1344,17 @@ class Trainer:
         return self._fleet_targets_cache[key]
 
     def _fleet_supports(self, cls_id: int):
-        """The class's ``(n_members, M, K, rung, rung)`` support stack
-        (member supports are already rung-padded in ``__init__``)."""
+        """The class's member-stacked support operand: ``(n_members, M, K,
+        rung, rung)`` for dense supports, or a leaf-wise member-stacked
+        :class:`~stmgcn_tpu.ops.tiling.TiledSupports` (members share one
+        rung-padded shape and block-column width, so the plans share a
+        treedef; the scan body's per-slot ``jnp.take`` is leaf-wise either
+        way). Member supports are already rung-padded in ``__init__``."""
         if cls_id not in self._fleet_supports_cache:
             cls = self._fleet_plan.classes[cls_id]
-            self._fleet_supports_cache[cls_id] = jnp.stack(
-                [self.supports.for_city(c) for c in cls.cities]
+            members = [self.supports.for_city(c) for c in cls.cities]
+            self._fleet_supports_cache[cls_id] = jax.tree.map(
+                lambda *leaves: jnp.stack(leaves), *members
             )
         return self._fleet_supports_cache[cls_id]
 

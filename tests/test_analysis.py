@@ -1622,6 +1622,256 @@ class TestPallasStaticCheck:
                 assert est["estimate_mib"] < 10.0, (site.fn, dtype, est)
 
 
+_SPMM_BAD_COVERAGE_FIXTURE = '''
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _spmm_call(data, idx, x, n, tile, interpret):
+    r, c_max = idx.shape
+    n_pad = r * tile
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r, mb, c_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, tile, tile), lambda i, j, c, idx_ref: (i, c, 0, 0)),
+            pl.BlockSpec((tile, tm), lambda i, j, c, idx_ref: (idx_ref[i, c], j)),
+        ],
+        out_specs=pl.BlockSpec((tile // 2, tm), lambda i, j, c, idx_ref: (i, j)),
+    )
+    out = pl.pallas_call(
+        _spmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_pad, m_pad), jnp.float32),
+        interpret=interpret,
+    )(idx, data, x_pad)
+    return out
+'''
+
+
+class TestSpmmPallasStaticCheck:
+    """PR 13 satellite: the site model extends to ops/spmm.py's three
+    PrefetchScalarGridSpec launches — keyword grid_spec unwrapping,
+    post-prefetch operand alignment, dynamic (idx_ref-gathered) axes,
+    and the VMEM boundary at the configured tile size."""
+
+    def _spmm_sites(self):
+        from stmgcn_tpu.analysis.pallas_check import (
+            _default_kernel_path,
+            extract_pallas_sites,
+        )
+
+        return extract_pallas_sites(_default_kernel_path("ops/spmm.py"))
+
+    def test_extracts_all_three_spmm_sites(self):
+        sites = self._spmm_sites()
+        assert {s.fn for s in sites} == {
+            "_spmm_call", "_stack_fwd_call", "_stack_bwd_call"
+        }
+        for s in sites:
+            # PrefetchScalarGridSpec: the index list is operand 0 with
+            # no BlockSpec of its own
+            assert s.num_scalar_prefetch == 1
+            assert len(s.in_specs) == len(s.operands) - 1
+            assert s.grid is not None and s.out_specs and s.out_shape
+
+    def test_repo_has_no_uncovered_pallas_call_site(self):
+        """Every pl.pallas_call in the package is in a module the
+        checker models — a new kernel file must extend KERNEL_MODULES
+        (and _site_env) or this trips."""
+        import os
+
+        import stmgcn_tpu
+        from stmgcn_tpu.analysis.pallas_check import (
+            KERNEL_MODULES,
+            _default_kernel_path,
+            extract_pallas_sites,
+        )
+
+        pkg = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
+        covered = {
+            os.path.normpath(_default_kernel_path(m)) for m in KERNEL_MODULES
+        }
+        offenders = []
+        for root, _, files in os.walk(pkg):
+            for name in files:
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.normpath(os.path.join(root, name))
+                if extract_pallas_sites(path) and path not in covered:
+                    offenders.append(path)
+        assert offenders == []
+
+    def test_dynamic_gather_axis_streams_without_coverage_claim(self):
+        from stmgcn_tpu.analysis.pallas_check import (
+            SpmmKernelPoint,
+            _site_blocks,
+        )
+
+        fwd = [s for s in self._spmm_sites() if s.fn == "_stack_fwd_call"][0]
+        _, uses = _site_blocks(fwd, SpmmKernelPoint())
+        x = [u for u in uses if u.operand == "<arg2>"][0]
+        assert x.streamed
+        # axis 1 is idx_ref[ki, i, c]-gathered: dynamic, never a bare
+        # grid param — the static coverage check must skip it
+        assert x.roles[1] == ("dynamic", None)
+        data = [u for u in uses if u.operand == "data"][0]
+        assert data.streamed and ("param", 0) in data.roles
+
+    def test_vmem_boundary_at_configured_tile(self):
+        """tile=512 clears the 16 MiB budget with headroom; tile=1024
+        blows it at every site — the pallas-vmem boundary the tile-plan
+        config rule mirrors."""
+        from stmgcn_tpu.analysis.pallas_check import (
+            SpmmKernelPoint,
+            check_pallas_kernels,
+            vmem_estimate,
+        )
+
+        ok = check_pallas_kernels(spmm_points=[SpmmKernelPoint(tile=512)])
+        assert [f for f in ok if "spmm" in f.path or "_call" in f.message] == []
+        big = SpmmKernelPoint(tile=1024)
+        findings = check_pallas_kernels(spmm_points=[big])
+        fired = {f.message.split("`")[1] for f in findings
+                 if f.rule == "pallas-vmem"}
+        assert fired == {"_spmm_call", "_stack_fwd_call", "_stack_bwd_call"}
+        est = vmem_estimate(
+            [s for s in self._spmm_sites() if s.fn == "_spmm_call"][0], big
+        )
+        assert est["estimate_mib"] > 16.0
+        small = vmem_estimate(
+            [s for s in self._spmm_sites() if s.fn == "_spmm_call"][0],
+            SpmmKernelPoint(tile=512),
+        )
+        assert small["estimate_mib"] < 10.0
+
+    def test_shipped_default_point_passes(self):
+        from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
+
+        findings = check_pallas_kernels()
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_bad_grid_coverage_fires_blockspec(self, tmp_path):
+        """A half-height out block under the same grid leaves half the
+        out rows unwritten — the static coverage check must fire."""
+        from stmgcn_tpu.analysis.pallas_check import (
+            SpmmKernelPoint,
+            check_pallas_kernels,
+        )
+
+        p = tmp_path / "bad_spmm.py"
+        p.write_text(_SPMM_BAD_COVERAGE_FIXTURE)
+        findings = check_pallas_kernels(
+            path=str(p), spmm_points=[SpmmKernelPoint()]
+        )
+        assert [f.rule for f in findings] == ["pallas-blockspec"]
+        assert "covers" in findings[0].message
+
+    def test_lstm_point_against_spmm_site_is_out_of_sync(self):
+        from stmgcn_tpu.analysis.pallas_check import (
+            KernelPoint,
+            _check_site,
+        )
+
+        site = [s for s in self._spmm_sites() if s.fn == "_spmm_call"][0]
+        findings = _check_site(site, KernelPoint())
+        assert [f.rule for f in findings] == ["pallas-blockspec"]
+        assert "out of sync" in findings[0].message
+
+
+class TestTilePlanRule:
+    """PR 13 satellite: the tile-plan config rule — pure config math
+    over the tiled-support knobs, with the VMEM boundary mirroring the
+    pallas-vmem fixtures (tile=512 pass, tile=1024 fire)."""
+
+    def _tiled(self, **kw):
+        from stmgcn_tpu.config import preset
+
+        cfg = preset("smoke")
+        cfg.model.tiled = True
+        for k, v in kw.items():
+            setattr(cfg.model, k, v)
+        return cfg
+
+    def test_rule_registered(self):
+        from stmgcn_tpu.analysis.rules import RULES
+
+        assert RULES["tile-plan"].severity == "error"
+
+    def test_shipped_presets_clean(self):
+        from stmgcn_tpu.analysis.tiling_check import check_tile_plan
+
+        assert check_tile_plan() == []
+
+    def test_untiled_config_is_a_no_op(self):
+        from stmgcn_tpu.config import preset
+        from stmgcn_tpu.analysis.tiling_check import tile_plan_violations
+
+        assert tile_plan_violations(preset("smoke").model, 8192) == []
+
+    def test_vmem_boundary_512_pass_1024_fire(self):
+        from stmgcn_tpu.analysis.pallas_check import VMEM_BUDGET_BYTES
+        from stmgcn_tpu.analysis.tiling_check import (
+            tile_plan_violations,
+            tiled_spmm_vmem_estimate,
+        )
+
+        ok = self._tiled(tile_size=512)
+        assert tile_plan_violations(ok.model, 8192) == []
+        assert tiled_spmm_vmem_estimate(512) < VMEM_BUDGET_BYTES
+        bad = self._tiled(tile_size=1024)
+        msgs = tile_plan_violations(bad.model, 8192)
+        assert len(msgs) == 1 and "VMEM" in msgs[0] and "25.28" in msgs[0]
+        assert tiled_spmm_vmem_estimate(1024) > VMEM_BUDGET_BYTES
+
+    def test_node_padding_waste_boundary(self):
+        """waste = 1 - N/padded against the budget: one node above the
+        boundary joins, at/below fires — pinned at tile=128, budget
+        0.75 (default), where the boundary N is exactly 32."""
+        from stmgcn_tpu.analysis.tiling_check import tile_plan_violations
+
+        cfg = self._tiled(tile_size=128)
+        assert cfg.model.tile_waste_budget == 0.75
+        assert tile_plan_violations(cfg.model, 32) == []  # waste == budget
+        msgs = tile_plan_violations(cfg.model, 31)  # one past it
+        assert len(msgs) == 1 and "tile_waste_budget" in msgs[0]
+
+    def test_knob_ranges(self):
+        from stmgcn_tpu.analysis.tiling_check import tile_plan_violations
+
+        assert "tile_size" in tile_plan_violations(
+            self._tiled(tile_size=0).model, 100
+        )[0]
+        assert "tile_waste_budget" in tile_plan_violations(
+            self._tiled(tile_waste_budget=0.0).model, 100
+        )[0]
+        assert "mutually exclusive" in tile_plan_violations(
+            self._tiled(sparse=True).model, 100
+        )[0]
+
+    def test_mesh_conflict_and_hetero_cities_via_check(self):
+        from stmgcn_tpu.config import MeshConfig, preset
+        from stmgcn_tpu.analysis.tiling_check import check_tile_plan
+
+        cfg = preset("multicity")
+        cfg.model.tiled = True
+        findings = check_tile_plan([("multicity-tiled", cfg)])
+        assert [f.rule for f in findings] == ["tile-plan"]
+        assert "mesh" in findings[0].message
+        assert findings[0].path == "<contract:tile-plan:multicity-tiled>"
+        cfg.mesh = MeshConfig()
+        assert check_tile_plan([("multicity-tiled", cfg)]) == []
+        # per-city sizes: a tile too large for the smallest city fires
+        # for that city only
+        cfg.model.tile_size = 512
+        cfg.model.tile_waste_budget = 0.5
+        findings = check_tile_plan([("multicity-tiled", cfg)])
+        assert all("city" in f.message for f in findings)
+        assert len(findings) == 2  # both 144- and 100-node cities
+
+
 class TestWholeProgramSuppression:
     """Suppression semantics under whole-program mode (satellite c)."""
 

@@ -1,0 +1,366 @@
+"""Tiled-sparse Chebyshev supports: plan, conv parity, routing, serving.
+
+The tiled path (``ops/tiling.py`` + ``TiledChebGraphConv``) is an
+offline reorder/condense of the dense ``(M, K, N, N)`` support stack
+into MXU-shaped ``(tile, tile)`` blocks. Its correctness contract is
+the dense path: one shared RCM-style permutation must round-trip
+exactly, the condensed blocks must reconstruct the permuted supports
+bit-for-bit, and the online apply (gathered-tiles XLA or the Pallas
+``spmm_stack`` kernel) must match ``ChebGraphConv`` on the same params
+— forward and gradient — across K in {2, 3} and M = 3 branch graphs.
+Above the ops layer, the experiment/trainer/serving wiring routes
+``model.tiled`` configs end to end: loop-layout params, fleet shape
+classes over tiled cities, and bit-identical tiled serving engines.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import MeshConfig, ServingConfig, preset
+from stmgcn_tpu.data import grid_adjacency
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.ops.chebconv import ChebGraphConv, TiledChebGraphConv
+from stmgcn_tpu.ops.tiling import (
+    TiledBranchSupports,
+    TiledSupports,
+    gathered_tiles_apply,
+    plan_tiling,
+    rcm_permutation,
+)
+
+M, TILE = 3, 8
+
+
+def scrambled_supports(side=8, m_graphs=M, order=2, seed=0, noise=0.0):
+    """Dense Chebyshev supports over M scrambled-grid graphs.
+
+    The node scramble destroys the grid's natural banded ordering — the
+    case RCM exists for. Condensation fixtures stay noise-free: even a
+    handful of uniform-random long-range edges wreck any bandwidth-
+    reducing order once the 2-hop Chebyshev supports square them (real
+    metro graphs are locally structured, not uniform-random). Parity
+    fixtures pass ``noise`` > 0 — the math must hold on any pattern.
+    """
+    rng = np.random.default_rng(seed)
+    n = side * side
+    shuffle = rng.permutation(n)
+    adjs = []
+    for m in range(m_graphs):
+        a = grid_adjacency(side)
+        extra = (rng.random((n, n)) < noise).astype(np.float32)
+        a = np.maximum(a, np.maximum(extra, extra.T))
+        np.fill_diagonal(a, 0)
+        adjs.append(a[shuffle][:, shuffle])
+    return SupportConfig("chebyshev", order).build_all(adjs)  # (M, order+1, N, N)
+
+
+def reconstruct(plan: TiledSupports) -> np.ndarray:
+    """Scatter a plan's blocks back to the dense *permuted* stack."""
+    t, r = plan.tile, plan.block_rows
+    n_pad = r * t
+    data = np.asarray(plan.data)
+    idx = np.asarray(plan.idx)
+    out = np.zeros((plan.m_graphs, plan.n_supports, n_pad, n_pad), np.float32)
+    for mi in range(plan.m_graphs):
+        for ki in range(plan.n_supports):
+            for ri in range(r):
+                for ci in range(idx.shape[3]):
+                    col = idx[mi, ki, ri, ci]
+                    out[mi, ki, ri * t:(ri + 1) * t, col * t:(col + 1) * t] += (
+                        data[mi, ki, ri, ci]
+                    )
+    return out[:, :, :plan.n, :plan.n]
+
+
+class TestPlanTiling:
+    def test_rcm_round_trip_identity(self):
+        dense = scrambled_supports()
+        perm = rcm_permutation(np.any(dense != 0.0, axis=(0, 1)))
+        n = dense.shape[-1]
+        assert sorted(perm.tolist()) == list(range(n))  # a true permutation
+        inv = np.argsort(perm)
+        x = np.random.default_rng(1).standard_normal(n)
+        np.testing.assert_array_equal(x[perm][inv], x)
+
+    def test_blocks_reconstruct_permuted_dense_exactly(self):
+        dense = scrambled_supports(noise=0.01)
+        plan = plan_tiling(dense, tile=TILE)
+        perm = np.asarray(plan.perm)
+        permuted = dense[:, :, perm][:, :, :, perm]
+        np.testing.assert_array_equal(reconstruct(plan), permuted)
+
+    def test_rcm_condenses_a_scrambled_grid(self):
+        dense = scrambled_supports(side=12)  # N=144: room to condense
+        stats = plan_tiling(dense, tile=TILE).tile_stats()
+        # identity-ordered: a scrambled grid's nonzeros land nearly
+        # everywhere; after RCM they cluster into a strict minority of
+        # the dense block grid
+        assert stats["blocks_kept"] < stats["blocks_dense_equivalent"]
+        assert stats["density"] < 0.8
+        assert 0 < stats["flops_ratio"] < 1
+        assert stats["nbytes"] < stats["dense_nbytes"]
+
+    def test_plan_validation(self):
+        with pytest.raises(ValueError, match="dense"):
+            plan_tiling(np.zeros((2, 3, 4)), tile=TILE)
+        with pytest.raises(ValueError, match="tile"):
+            plan_tiling(scrambled_supports(), tile=0)
+        plan = plan_tiling(scrambled_supports(), tile=TILE)
+        with pytest.raises(ValueError, match="shrink"):
+            plan.pad_to(plan.n - 1)
+        with pytest.raises(ValueError, match="narrow"):
+            plan.with_block_cols(0, 0)
+        with pytest.raises(TypeError, match="int"):
+            plan[0:1]
+
+    def test_pad_to_keeps_new_nodes_isolated(self):
+        dense = scrambled_supports()
+        plan = plan_tiling(dense, tile=TILE)
+        rung = plan.n + TILE + 3  # crosses a tile boundary
+        padded = plan.pad_to(rung)
+        assert padded.n == rung and len(np.asarray(padded.perm)) == rung
+        # the padded rows/cols of the reconstruction are exactly zero and
+        # the original permuted stack is untouched
+        rec = reconstruct(padded)
+        np.testing.assert_array_equal(rec[:, :, :plan.n, :plan.n],
+                                      reconstruct(plan))
+        assert not rec[:, :, plan.n:, :].any()
+        assert not rec[:, :, :, plan.n:].any()
+
+
+class TestTiledConvParity:
+    @pytest.mark.parametrize("order", [1, 2])  # K = order + 1 in {2, 3}
+    def test_forward_and_grad_match_dense(self, order):
+        dense = scrambled_supports(order=order, noise=0.01)
+        plan = plan_tiling(dense, tile=TILE)
+        n = dense.shape[-1]
+        k = order + 1
+        x = jnp.asarray(
+            np.random.default_rng(2).standard_normal((4, n, 2)).astype(np.float32)
+        )
+        ref = ChebGraphConv(n_supports=k, features=5)
+        tiled = TiledChebGraphConv(n_supports=k, features=5, backend="xla")
+        params = ref.init(jax.random.key(0), jnp.asarray(dense[0]), x)
+        assert jax.tree.structure(params) == jax.tree.structure(
+            tiled.init(jax.random.key(0), plan[0], x)
+        )  # shared (K*F_in, F_out) layout — params are interchangeable
+        for m in range(M):
+            want = ref.apply(params, jnp.asarray(dense[m]), x)
+            got = tiled.apply(params, plan[m], x)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5, atol=1e-5)
+
+        def loss_ref(xx, sup):
+            return (ref.apply(params, sup, xx) ** 2).sum()
+
+        def loss_tiled(xx, branch):
+            return (tiled.apply(params, branch, xx) ** 2).sum()
+
+        g_ref = jax.grad(loss_ref)(x, jnp.asarray(dense[1]))
+        g_tiled = jax.grad(loss_tiled)(x, plan[1])
+        np.testing.assert_allclose(np.asarray(g_tiled), np.asarray(g_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_pallas_interpret_backend_matches_xla(self):
+        dense = scrambled_supports(side=4, order=1)
+        plan = plan_tiling(dense, tile=4)
+        n = dense.shape[-1]
+        x = jnp.asarray(
+            np.random.default_rng(3).standard_normal((2, n, 1)).astype(np.float32)
+        )
+        xla = TiledChebGraphConv(n_supports=2, features=3, backend="xla")
+        pal = TiledChebGraphConv(n_supports=2, features=3, backend="pallas")
+        params = xla.init(jax.random.key(0), plan[0], x)
+        np.testing.assert_allclose(
+            np.asarray(pal.apply(params, plan[0], x)),
+            np.asarray(xla.apply(params, plan[0], x)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_gathered_tiles_apply_matches_matmul(self):
+        dense = scrambled_supports(order=2, noise=0.01)
+        plan = plan_tiling(dense, tile=TILE)
+        n = dense.shape[-1]
+        x = np.random.default_rng(4).standard_normal((n, 6)).astype(np.float32)
+        perm = np.asarray(plan.perm)
+        for m in range(M):
+            got = np.asarray(gathered_tiles_apply(plan[m], jnp.asarray(x[perm])))
+            permuted = dense[m][:, perm][:, :, perm]
+            want = np.einsum("kij,jf->kif", permuted, x[perm])
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def tiled_cfg(out_dir=None, **model_kw):
+    cfg = preset("smoke")
+    cfg.model.tiled = True
+    cfg.model.tile_size = TILE
+    for k, v in model_kw.items():
+        setattr(cfg.model, k, v)
+    cfg.train.epochs = 1
+    if out_dir is not None:
+        cfg.train.out_dir = str(out_dir)
+    return cfg
+
+
+class TestTiledRouting:
+    def test_route_supports_returns_tiled_modes(self):
+        from stmgcn_tpu.experiment import build_dataset, route_supports
+
+        cfg = tiled_cfg()
+        sup, modes = route_supports(cfg, build_dataset(cfg))
+        assert modes == ("tiled",) * cfg.model.m_graphs
+        assert isinstance(sup, TiledSupports)
+        assert isinstance(sup[0], TiledBranchSupports)
+
+    def test_build_model_derives_loop_layout(self):
+        from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
+
+        cfg = tiled_cfg()
+        ds = build_dataset(cfg)
+        sup, _ = route_supports(cfg, ds)
+        model = build_model(cfg, ds.n_feats)  # no explicit modes: config-derived
+        assert model.branch_modes() == ("tiled",) * cfg.model.m_graphs
+        x = jnp.zeros((2, cfg.data.seq_len, ds.n_nodes, ds.n_feats), jnp.float32)
+        params = model.init(jax.random.key(0), sup, x)
+        assert "branch_0" in params["params"] and "branches" not in params["params"]
+
+    def test_tiled_plus_sparse_rejected(self):
+        from stmgcn_tpu.experiment import build_dataset, build_supports
+
+        cfg = tiled_cfg(sparse=True)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            build_supports(cfg, build_dataset(cfg))
+
+    def test_tiled_plus_mesh_rejected(self):
+        from stmgcn_tpu.experiment import build_dataset, route_supports
+
+        cfg = tiled_cfg()
+        cfg.mesh = MeshConfig(dp=2)
+        with pytest.raises(ValueError, match="mesh"):
+            route_supports(cfg, build_dataset(cfg))
+
+    def test_waste_budget_enforced(self):
+        from stmgcn_tpu.experiment import build_dataset, build_supports
+
+        cfg = tiled_cfg()
+        cfg.model.tile_waste_budget = 1e-9
+        with pytest.raises(ValueError, match="tile_waste_budget"):
+            build_supports(cfg, build_dataset(cfg))
+
+    def test_smoke_preset_trains_tiled_end_to_end(self, tmp_path):
+        from stmgcn_tpu.experiment import build_trainer
+
+        cfg = tiled_cfg(tmp_path)
+        trainer = build_trainer(cfg, verbose=False)
+        hist = trainer.train()
+        assert np.isfinite(hist["train"][0])
+
+
+class TestTiledFleetAndServing:
+    @pytest.fixture(scope="class")
+    def fleet_run(self, tmp_path_factory):
+        """One hetero tiled training run shared by the serving assertions."""
+        from stmgcn_tpu.experiment import build_dataset, build_supports, build_trainer
+        from stmgcn_tpu.inference import Forecaster
+
+        out = tmp_path_factory.mktemp("tiled_fleet")
+        cfg = preset("multicity")
+        cfg.mesh = MeshConfig()
+        cfg.data.city_rows = (5, 4)
+        cfg.data.cols = 5
+        cfg.data.city_timesteps = None
+        cfg.data.n_timesteps = 24 * 7 * 2 + 48
+        cfg.model.tiled = True
+        cfg.model.tile_size = TILE
+        cfg.train.epochs = 1
+        cfg.train.steps_per_superstep = 4
+        cfg.train.fleet = True
+        cfg.train.out_dir = str(out)
+        trainer = build_trainer(cfg, verbose=False)
+        trainer.train()
+        fc = Forecaster.from_checkpoint(str(out / "best.ckpt"))
+        plans = build_supports(cfg, build_dataset(cfg))
+        return cfg, trainer, fc, plans
+
+    def test_fleet_superstep_engages_on_tiled_cities(self, fleet_run):
+        _, trainer, _, plans = fleet_run
+        assert trainer.train_path == "fleet_superstep"
+        assert all(isinstance(p, TiledSupports) for p in plans.per_city)
+
+    def test_fleet_engine_private_exact_fit_classes(self, fleet_run):
+        from stmgcn_tpu.serving import FleetServingEngine
+
+        _, _, fc, plans = fleet_run
+        scfg = ServingConfig(buckets=(4,), max_batch=4)
+        with FleetServingEngine.from_forecaster(fc, plans, config=scfg) as eng:
+            # tiled cities never rung-share: one exact-fit class each
+            assert sorted(eng._groups) == sorted(
+                (p.n, (c,)) for c, p in enumerate(plans.per_city)
+            )
+            for c, plan in enumerate(plans.per_city):
+                hist = np.random.default_rng(c).standard_normal(
+                    (2, fc.seq_len, plan.n, fc.derived["input_dim"])
+                ).astype(np.float32)
+                want = fc.predict(plan, hist, city=c)
+                got = eng.predict_direct(hist, city=c)
+                np.testing.assert_array_equal(got, want)  # bit parity
+            gen0 = eng.generation
+            assert eng.swap_params(fc.params) == gen0 + 1  # fleet-wide swap
+
+    def test_serving_engine_tiled_city(self, fleet_run):
+        from stmgcn_tpu.serving import ServingEngine
+
+        _, _, fc, plans = fleet_run
+        plan = plans.per_city[0]
+        scfg = ServingConfig(buckets=(4,), max_batch=4)
+        with ServingEngine.from_forecaster(fc, plan, config=scfg, city=0) as eng:
+            hist = np.random.default_rng(9).standard_normal(
+                (3, fc.seq_len, plan.n, fc.derived["input_dim"])
+            ).astype(np.float32)
+            want = fc.predict(plan, hist, city=0)
+            np.testing.assert_array_equal(eng.predict_direct(hist), want)
+            pre = eng.predict_direct(hist)
+            eng.swap_params(fc.params)  # same params — output unchanged
+            np.testing.assert_array_equal(eng.predict_direct(hist), pre)
+
+
+class TestFootprint:
+    def test_tiled_apply_never_materializes_dense_supports(self):
+        """Laziness pin: no intermediate in the tiled conv's jaxpr is
+        anywhere near the dense N^2 support stack a (K, N, N) apply
+        would carry."""
+        side = 16  # N = 256, two tile rows at tile=128
+        dense = scrambled_supports(side=side, m_graphs=1, order=2)
+        plan = plan_tiling(dense, tile=128)
+        n = dense.shape[-1]
+        x = jnp.zeros((1, n, 1), jnp.float32)
+        conv = TiledChebGraphConv(n_supports=3, features=4, backend="xla")
+        params = conv.init(jax.random.key(0), plan[0], x)
+
+        avals = []
+
+        def walk(jaxpr):
+            for eqn in jaxpr.eqns:
+                avals.extend(v.aval for v in eqn.outvars)
+                for sub in jax.core.jaxprs_in_params(eqn.params):
+                    walk(sub)
+
+        walk(jax.make_jaxpr(
+            lambda b, xx: conv.apply(params, b, xx)
+        )(plan[0], x).jaxpr)
+        biggest = max(int(np.prod(a.shape)) for a in avals if hasattr(a, "shape"))
+        # the largest tiled intermediate is the gathered block tensor
+        # (K * R * C * tile * BF) — far under the (K, N, N) dense stack
+        assert biggest < 3 * n * n
+
+    def test_plan_is_smaller_than_dense_for_structured_graphs(self):
+        # tile must track sqrt(N)-ish bandwidth: at tile=64 on N=256 the
+        # forward+transpose blocks outweigh dense — 16 wins handily
+        dense = scrambled_supports(side=16, m_graphs=1, order=2)
+        plan = plan_tiling(dense, tile=16)
+        stats = plan.tile_stats()
+        assert stats["nbytes"] < stats["dense_nbytes"]

@@ -14,7 +14,9 @@
 #    "health": {"exit": N, "nonfinite": N|null, "records": N|null,
 #    "findings": N|null},
 #    "continual": {"exit": N, "promotions": N|null, "rejections": N|null,
-#    "nonfinite": N|null}}
+#    "nonfinite": N|null},
+#    "spmd": {"exit": N, "programs": N|null, "collectives": N|null,
+#    "findings": N|null}}
 #
 # The "concurrency" section is explicit evidence the static concurrency
 # pass (unguarded-attr / lock-order-cycle / condvar-discipline /
@@ -150,11 +152,31 @@ EOF
 continual_exit=$?
 printf '%s\n' "$continual_json" >&2
 
+# SPMD contract evidence: the pass must have lowered every probe program
+# (zero programs means the probes silently stopped building — the same
+# empty-database failure mode the concurrency section guards against)
+# and observed a non-trivially-collective-free fleet with zero findings.
+spmd_json=$("$PY" - <<'EOF' 2>>/dev/stderr
+import json
+
+from stmgcn_tpu.utils.platform import force_host_platform
+
+force_host_platform("cpu", n_devices=8)
+
+from stmgcn_tpu.analysis.spmd_check import spmd_summary
+
+print(json.dumps(spmd_summary()))
+EOF
+)
+spmd_exit=$?
+printf '%s\n' "$spmd_json" >&2
+
 LINT_JSON="$lint_json" LINT_EXIT="$lint_exit" \
 CONC_JSON="$conc_json" CONC_EXIT="$conc_exit" \
 RUFF_AVAILABLE="$ruff_available" RUFF_EXIT="$ruff_exit" \
 OBS_JSON="$obs_json" OBS_EXIT="$obs_exit" \
 CONTINUAL_JSON="$continual_json" CONTINUAL_EXIT="$continual_exit" \
+SPMD_JSON="$spmd_json" SPMD_EXIT="$spmd_exit" \
 "$PY" - <<'EOF'
 import json
 import os
@@ -183,6 +205,11 @@ try:
 except ValueError:
     continual = {}
 continual_exit = int(os.environ["CONTINUAL_EXIT"])
+try:
+    spmd = json.loads(os.environ["SPMD_JSON"])
+except ValueError:
+    spmd = {}
+spmd_exit = int(os.environ["SPMD_EXIT"])
 
 ok = lint_exit == 0 and report.get("errors") == 0
 # concurrency pass must have run over a real class model and come back
@@ -205,6 +232,12 @@ ok = ok and continual_exit == 0
 ok = ok and continual.get("promotions") == 1
 ok = ok and continual.get("rejections") == 1
 ok = ok and continual.get("nonfinite") == 0
+# spmd contract pass: every probe program lowered (zero programs means
+# the probes stopped building) with zero collective-manifest/wire/
+# footprint findings
+ok = ok and spmd_exit == 0
+ok = ok and (spmd.get("programs") or 0) > 0
+ok = ok and spmd.get("findings") == 0
 print(json.dumps({
     "gate": "PASS" if ok else "FAIL",
     "lint": {
@@ -236,6 +269,12 @@ print(json.dumps({
         "promotions": continual.get("promotions"),
         "rejections": continual.get("rejections"),
         "nonfinite": continual.get("nonfinite"),
+    },
+    "spmd": {
+        "exit": spmd_exit,
+        "programs": spmd.get("programs"),
+        "collectives": spmd.get("collectives"),
+        "findings": spmd.get("findings"),
     },
 }))
 sys.exit(0 if ok else 1)

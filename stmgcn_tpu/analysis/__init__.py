@@ -70,7 +70,12 @@ from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
 from stmgcn_tpu.analysis.obs_check import check_obs_overhead
 from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
 from stmgcn_tpu.analysis.program_db import ProgramDB
-from stmgcn_tpu.analysis.report import Finding, render_json, render_text
+from stmgcn_tpu.analysis.report import (
+    Finding,
+    render_json,
+    render_sarif,
+    render_text,
+)
 from stmgcn_tpu.analysis.resident_check import check_resident_memory
 from stmgcn_tpu.analysis.rules import RULES, Rule
 from stmgcn_tpu.analysis.serving_check import (
@@ -78,6 +83,11 @@ from stmgcn_tpu.analysis.serving_check import (
     check_serving_slo,
 )
 from stmgcn_tpu.analysis.sharding_check import check_partition_specs
+from stmgcn_tpu.analysis.spmd_check import (
+    check_spmd_contracts,
+    declared_manifests,
+    spmd_summary,
+)
 from stmgcn_tpu.analysis.tiling_check import check_tile_plan
 
 __all__ = [
@@ -96,11 +106,15 @@ __all__ = [
     "check_resident_memory",
     "check_serving_buckets",
     "check_serving_slo",
+    "check_spmd_contracts",
     "check_step_contracts",
     "check_tile_plan",
+    "declared_manifests",
     "lint_package",
     "lint_paths",
     "lint_source",
     "render_json",
+    "render_sarif",
     "render_text",
+    "spmd_summary",
 ]

@@ -40,7 +40,9 @@ def build_lint_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*",
                    help="files/directories to lint (default: the installed "
                         "stmgcn_tpu package, plus contract checks)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"), default="text",
+                   help="'sarif' emits one SARIF 2.1.0 document on stdout "
+                        "(code-scanning upload); 'json' the native report")
     p.add_argument("--no-contracts", action="store_true",
                    help="skip the jaxpr/sharding contract pass (pure-AST "
                         "mode: fast, no JAX initialization)")
@@ -59,9 +61,11 @@ def build_lint_parser() -> argparse.ArgumentParser:
     p.add_argument("--rebaseline", action="store_true",
                    help="measure the step programs' primitive counts and "
                         "rewrite PRIMITIVE_BUDGETS (measured x ~2 headroom) "
-                        "in stmgcn_tpu/analysis/jaxpr_check.py, then exit — "
-                        "the deliberate-rebaseline command for features that "
-                        "move a step's op count")
+                        "in stmgcn_tpu/analysis/jaxpr_check.py, and measure "
+                        "the spmd probe programs' collective bytes-on-wire "
+                        "and rewrite WIRE_BUDGETS in analysis/spmd_check.py, "
+                        "then exit — the deliberate-rebaseline command for "
+                        "features that move a step's op count or wire volume")
     return p
 
 
@@ -80,12 +84,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         import json
 
         from stmgcn_tpu.analysis.jaxpr_check import rebaseline
+        from stmgcn_tpu.analysis.spmd_check import rebaseline_wire
         from stmgcn_tpu.utils.platform import force_host_platform
 
-        force_host_platform("cpu")  # never queue on (or wake) an accelerator
+        # never queue on (or wake) an accelerator; 8 virtual host devices
+        # so the spmd probe programs can lower on every preset's mesh
+        force_host_platform("cpu", n_devices=8)
         result = rebaseline(preset_name=args.preset)
+        wire = rebaseline_wire()
         if args.format == "json":
-            print(json.dumps(result))
+            print(json.dumps({**result, "wire": wire}))
         else:
             for name, count in result["counts"].items():
                 print(
@@ -93,10 +101,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"budget {result['budgets'][name]}"
                 )
             print(f"rewrote PRIMITIVE_BUDGETS in {result['path']}")
+            for name, total in wire["totals"].items():
+                print(
+                    f"{name}: measured {total} collective bytes -> "
+                    f"budget {wire['budgets'][name]}"
+                )
+            print(f"rewrote WIRE_BUDGETS in {wire['path']}")
         return 0
 
     from stmgcn_tpu.analysis.lint import lint_package, lint_paths
-    from stmgcn_tpu.analysis.report import render_json, render_text
+    from stmgcn_tpu.analysis.report import render_json, render_sarif, render_text
 
     if args.paths:
         findings = lint_paths(
@@ -126,10 +140,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             check_serving_slo,
         )
         from stmgcn_tpu.analysis.sharding_check import check_partition_specs
+        from stmgcn_tpu.analysis.spmd_check import check_spmd_contracts
         from stmgcn_tpu.analysis.tiling_check import check_tile_plan
         from stmgcn_tpu.utils.platform import force_host_platform
 
-        force_host_platform("cpu")
+        # 8 virtual host devices: the spmd contract pass lowers the real
+        # sharded step programs on every preset's mesh (dp x region x
+        # branch extents all fit in 8) without touching an accelerator
+        force_host_platform("cpu", n_devices=8)
         findings.extend(check_partition_specs())
         findings.extend(check_collective_contracts())
         findings.extend(check_resident_memory())
@@ -145,13 +163,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # --no-contracts' no-JAX promise must not do
         findings.extend(check_pallas_kernels())
         findings.extend(check_step_contracts(args.preset))
+        findings.extend(check_spmd_contracts())
     elif not args.paths:
         from stmgcn_tpu.analysis.sharding_check import check_partition_specs
 
         findings.extend(check_partition_specs())
 
-    out = render_json(findings) if args.format == "json" else render_text(findings)
-    print(out)
+    renderers = {"json": render_json, "sarif": render_sarif, "text": render_text}
+    print(renderers[args.format](findings))
     return 1 if any(
         f.severity == "error" and not f.suppressed for f in findings
     ) else 0

@@ -1,0 +1,213 @@
+"""Compiled-HLO collective extraction with mesh-axis attribution.
+
+:mod:`stmgcn_tpu.utils.comm` tallies collective kinds and bytes; this
+module additionally recovers *which mesh axes* each collective spans, by
+parsing the op's ``replica_groups`` (or ``source_target_pairs``) and
+matching the observed device grouping against the partitions a
+``(dp, region[, branch])`` mesh induces. That attribution is what turns
+"the program all-gathers 2 KiB" into "the program all-gathers the node
+axis over ``region``" — the unit the :mod:`.spmd_check` manifests are
+declared in.
+
+Partition ids in a jit-compiled SPMD module index the mesh's device
+array in row-major axis order (``build_mesh`` constructs ``Mesh(devs
+.reshape(dp, region[, branch]), names)`` and XLA's device assignment is
+that array flattened), so axis membership is pure arithmetic on the ids
+— no devices touched. Both ``replica_groups`` syntaxes XLA prints are
+handled: the explicit form ``{{0,4},{1,5}}`` and the iota form
+``[4,2]<=[2,4]T(1,0)`` (group shape ``<=`` iota dims with an optional
+transpose; reshape of the transposed iota yields the groups).
+
+Byte counts are per-op *output* shapes (an all-gather's output is the
+gathered tensor, a permute's the shifted block) — the same wire-volume
+proxy :func:`stmgcn_tpu.utils.comm.collective_stats` uses, and async
+``-start``/``-done`` pairs count once with the start tuple's result
+element only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from stmgcn_tpu.utils.comm import COLLECTIVES
+
+__all__ = ["CollectiveOp", "collect_collectives", "infer_axes"]
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_OP_RE = re.compile(
+    r"%(\S+?)\s*=\s*(?:\(([^)]*)\)|(\w+)\[([\d,]*)\][^ ]*)\s+("
+    + "|".join(COLLECTIVES)
+    + r")(-start)?\("
+)
+_TUPLE_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{(\{[\d,{} ]*\})?\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?"
+)
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
+_WHILE_RE = re.compile(r"=\s*(\([^)]*\)|\S+)\s+while\(")
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in a compiled module, attributed to mesh axes.
+
+    ``axes`` is ``"dp"`` / ``"region"`` / ``"branch"`` / a ``"+"``-joined
+    combination, or ``"?"`` when the grouping matches no axis subset of
+    the mesh (an op the plan has no vocabulary for — always a finding).
+    """
+
+    kind: str
+    axes: str
+    out_bytes: int
+    name: str  # HLO op name, e.g. "all-gather.1"
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    size = _DTYPE_BYTES.get(dtype)
+    if size is None:
+        return 0
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * size
+
+
+def _parse_groups(line: str, n_devices: int) -> Optional[List[Tuple[int, ...]]]:
+    """Replica groups as id tuples, or None when the line carries none."""
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        gshape = [int(d) for d in m.group(1).split(",")]
+        idims = [int(d) for d in m.group(2).split(",")]
+        ids = list(range(math.prod(idims)))
+        if m.group(3):
+            perm = [int(d) for d in m.group(3).split(",")]
+            # transpose the iota array: id at multi-index i goes to i[perm]
+            strides = [0] * len(idims)
+            acc = 1
+            for ax in reversed(range(len(idims))):
+                strides[ax] = acc
+                acc *= idims[ax]
+            out = []
+            for idx in itertools.product(*[range(idims[p]) for p in perm]):
+                out.append(sum(idx[k] * strides[perm[k]] for k in range(len(perm))))
+            ids = out
+        size = gshape[-1] if len(gshape) > 1 else gshape[0]
+        n_groups = math.prod(gshape) // size if len(gshape) > 1 else 1
+        return [
+            tuple(ids[g * size:(g + 1) * size]) for g in range(max(1, n_groups))
+        ]
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        if not m.group(1):  # replica_groups={} — every device, one group
+            return [tuple(range(n_devices))]
+        return [
+            tuple(int(x) for x in grp.split(","))
+            for grp in re.findall(r"\{([\d,]+)\}", m.group(1))
+        ]
+    return None
+
+
+def _parse_pairs(line: str) -> Optional[List[Tuple[int, int]]]:
+    m = _PAIRS_RE.search(line)
+    if not m:
+        return None
+    return [
+        (int(a), int(b))
+        for a, b in re.findall(r"\{(\d+),(\d+)\}", m.group(1))
+    ]
+
+
+def _coords(pid: int, shape: Sequence[int]) -> Tuple[int, ...]:
+    out = []
+    for extent in reversed(shape):
+        out.append(pid % extent)
+        pid //= extent
+    return tuple(reversed(out))
+
+
+def infer_axes(
+    line: str, mesh_shape: Sequence[int], axis_names: Sequence[str]
+) -> str:
+    """Mesh axes a collective op line spans, from its groups/pairs.
+
+    For grouped collectives the observed groups must equal the partition
+    induced by some non-empty subset of mesh axes (vary the subset, fix
+    the rest); for ``collective-permute`` every source→target pair must
+    differ in exactly one (common) axis coordinate. ``"?"`` otherwise.
+    """
+    n = math.prod(mesh_shape)
+    pairs = _parse_pairs(line)
+    if pairs is not None:
+        axes = set()
+        for a, b in pairs:
+            ca, cb = _coords(a, mesh_shape), _coords(b, mesh_shape)
+            diff = [i for i in range(len(mesh_shape)) if ca[i] != cb[i]]
+            if len(diff) != 1:
+                return "?"
+            axes.add(diff[0])
+        return axis_names[axes.pop()] if len(axes) == 1 else "?"
+    groups = _parse_groups(line, n)
+    if groups is None:  # no grouping printed — spans every device
+        groups = [tuple(range(n))]
+    if all(len(g) == 1 for g in groups):
+        # singleton groups: a degenerate collective over an extent-1 axis
+        # partition — no device exchanges data with any other
+        return ""
+    observed = {frozenset(g) for g in groups}
+    n_axes = len(mesh_shape)
+    for r in range(1, n_axes + 1):
+        for subset in itertools.combinations(range(n_axes), r):
+            expect: dict = {}
+            for pid in range(n):
+                c = _coords(pid, mesh_shape)
+                key = tuple(c[i] for i in range(n_axes) if i not in subset)
+                expect.setdefault(key, []).append(pid)
+            if {frozenset(g) for g in expect.values()} == observed:
+                return "+".join(axis_names[i] for i in subset)
+    return "?"
+
+
+def collect_collectives(
+    hlo_text: str, mesh_shape: Sequence[int], axis_names: Sequence[str]
+) -> Tuple[List[CollectiveOp], int]:
+    """All collectives in a compiled module with axis attribution.
+
+    Returns ``(ops, while_count)``; a nonzero ``while_count`` means the
+    static per-op counts under-report runtime volume (loop trip counts
+    don't multiply through), same caveat as ``collective_stats``.
+    """
+    ops: List[CollectiveOp] = []
+    while_count = 0
+    for line in hlo_text.splitlines():
+        if _WHILE_RE.search(line):
+            while_count += 1
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        name, tuple_shape, dtype, dims, kind, is_start = m.groups()
+        axes = infer_axes(line, mesh_shape, axis_names)
+        if axes == "":  # degenerate singleton grouping: zero bytes on wire
+            continue
+        if dtype is not None:
+            nbytes = _shape_bytes(dtype, dims)
+        else:
+            elems = _TUPLE_SHAPE_RE.findall(tuple_shape)
+            if is_start:
+                nonscalar = [e for e in elems if e[1]]
+                elems = (nonscalar or elems)[-1:]
+            nbytes = sum(_shape_bytes(dt, dm) for dt, dm in elems)
+        ops.append(
+            CollectiveOp(kind=kind, axes=axes, out_bytes=nbytes, name=name)
+        )
+    return ops, while_count

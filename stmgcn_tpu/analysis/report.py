@@ -13,7 +13,7 @@ import dataclasses
 import json
 from typing import Iterable, List
 
-__all__ = ["Finding", "render_json", "render_text"]
+__all__ = ["Finding", "render_json", "render_sarif", "render_text"]
 
 #: bumped when the JSON report shape or rule ids change incompatibly
 #: (v2: whole-program lint — findings carry ``chain``/``suppressed``,
@@ -92,3 +92,81 @@ def render_json(findings: Iterable[Finding]) -> str:
         "findings": [f.to_dict() for f in ordered],
     }
     return json.dumps(payload, indent=2)
+
+
+def render_sarif(findings: Iterable[Finding]) -> str:
+    """One SARIF 2.1.0 document (``stmgcn lint --format sarif``).
+
+    The stdout contract is a *single* JSON document — one ``runs`` entry
+    for the whole invocation, every rule that produced a finding listed
+    in ``tool.driver.rules``, one ``result`` per finding. Contract-pass
+    findings use their virtual ``<contract:...>`` paths verbatim as
+    artifact URIs (they have no file), with the 1-based SARIF minimum
+    ``startLine`` of 1 standing in for line 0. Suppressed findings carry
+    a ``suppressions`` entry (``kind: inSource``) so uploaders hide them
+    without losing the record — mirroring ``render_json``, where they
+    are listed but never counted.
+    """
+    from stmgcn_tpu.analysis.rules import RULES
+
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    rule_ids = sorted({f.rule for f in ordered})
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": RULES[rid].summary if rid in RULES else rid
+            },
+            "defaultConfiguration": {
+                "level": "error"
+                if rid in RULES and RULES[rid].severity == "error"
+                else "warning"
+            },
+        }
+        for rid in rule_ids
+    ]
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in ordered:
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": max(1, f.line),
+                            "startColumn": max(1, f.col),
+                        },
+                    }
+                }
+            ],
+        }
+        if f.chain:
+            res["properties"] = {"chain": list(f.chain)}
+        if f.suppressed:
+            res["suppressions"] = [{"kind": "inSource"}]
+        results.append(res)
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "stmgcn-lint",
+                        "informationUri": (
+                            "https://github.com/stmgcn-tpu/stmgcn-tpu"
+                        ),
+                        "version": str(REPORT_VERSION),
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

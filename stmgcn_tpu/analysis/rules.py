@@ -226,6 +226,33 @@ _ALL_RULES = [
         "configured tile size past the ~16 MiB/core budget — pure "
         "config math, detectable before any adjacency is built",
     ),
+    # -- pass 2g: SPMD collective contracts (spmd_check) ------------------
+    Rule(
+        "spmd-collective-manifest",
+        "error",
+        "a multi-device preset's compiled step program contains a "
+        "collective (kind x mesh axes) its plan never declared — implicit "
+        "GSPMD resharding, e.g. a full node-axis all-gather erasing the "
+        "banded plan's wire savings — or a declared required collective "
+        "never appears, meaning the plan did not engage",
+    ),
+    Rule(
+        "spmd-wire-budget",
+        "error",
+        "a compiled program's collective bytes-on-wire exceed the "
+        "rebaselined per-program budget, a halo permute moves more than "
+        "the boundary-rows bound, or dp all-reduce traffic exceeds the "
+        "gradient-psum model (2 x param_bytes + slack) — a communication "
+        "regression; rebaseline deliberately if intended",
+    ),
+    Rule(
+        "spmd-shard-footprint",
+        "error",
+        "a multi-device preset's per-device sharded operand footprint "
+        "(support strips/shards + batch shard) exceeds the per-core "
+        "budget — the resident-memory math extended to mesh shards; the "
+        "step OOMs on every device at once",
+    ),
     Rule(
         "partition-axis-name",
         "error",

@@ -1,0 +1,671 @@
+"""Pass 2g: SPMD contracts — compiled collectives vs declared manifests.
+
+Every other contract pass reasons *a priori* (config math, abstract
+traces). This one closes the loop the way ``pallas_check`` did for
+Mosaic VMEM: it lowers the **real sharded train/serve step programs**
+for each multi-device preset on the virtual CPU mesh (the same
+``--xla_force_host_platform_device_count`` substrate ``dryrun_multichip``
+and the 8-virtual-device tests use — no accelerator, no execution),
+walks the post-partitioning HLO for collectives (:mod:`.hlo`), and diffs
+what GSPMD actually emitted against the plan's declared
+:class:`~stmgcn_tpu.parallel.manifest.CollectiveManifest`. Three rules:
+
+- ``spmd-collective-manifest``: an observed collective with no matching
+  declaration is implicit GSPMD resharding the plan never asked for
+  (e.g. a full node-axis all-gather silently erasing the banded plan's
+  N/(2·halo)x wire reduction); a *required* declaration with no observed
+  op means the plan never engaged (e.g. banded routing fell back to
+  dense without anyone noticing).
+- ``spmd-wire-budget``: observed bytes-on-wire per program vs the
+  rebaselined :data:`WIRE_BUDGETS` ceiling, plus two analytic models —
+  every region halo ``collective-permute`` must fit the boundary-rows
+  bound ``halo x B_local x M_local x F_cap x itemsize``, and the dp
+  gradient-sync all-reduce total must fit ``2 x param_bytes`` slack.
+  Budgets are maintained by ``stmgcn lint --rebaseline`` exactly like
+  jaxpr primitive budgets.
+- ``spmd-shard-footprint``: the ``resident-memory`` math extended from
+  whole-array to **per-device** operand footprints (supports strip/shard
+  + batch shard per device vs the per-core budget) for every
+  multi-device preset — the rule extension ROADMAP item 3 asks for.
+
+The probe programs shrink data/model dims (dryrun-style) so lowering
+stays in CPU-compile seconds, but keep each preset's mesh axes and
+routing decisions — the manifest's vocabulary (collective kind x mesh
+axes) is shrink-invariant. Lowerings are cached per program: all three
+rules and the lint-gate summary read one compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.hlo import CollectiveOp, collect_collectives
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = [
+    "PROGRAM_SPECS",
+    "WIRE_BUDGETS",
+    "analyze_program",
+    "check_shard_footprints",
+    "check_spmd_contracts",
+    "declared_manifests",
+    "estimate_shard_footprint",
+    "rebaseline_wire",
+    "spmd_summary",
+]
+
+#: static per-program wire ceilings (total collective output bytes in the
+#: compiled module), measured x ~2 headroom, rounded up to the next KiB.
+#: Single-line literal: ``stmgcn lint --rebaseline`` rewrites it in place
+#: from fresh measurements (:func:`rebaseline_wire`).
+WIRE_BUDGETS = {"multicity/train": 8192, "multicity/serve": 1024, "scaled/train": 60416, "scaled/serve": 27648, "branchpar/train": 6144, "branchpar/serve": 2048, "bandedbranch/train": 15360, "bandedbranch/serve": 4096}
+
+#: probe program registry: name -> (preset, "train"|"serve", banded?).
+#: Every preset whose mesh spans >1 device must appear here (coverage is
+#: itself checked); ``banded`` marks programs whose routing must engage
+#: the explicit halo plan, which flips the manifest's required ops.
+PROGRAM_SPECS = {
+    "multicity/train": ("multicity", "train", False),
+    "multicity/serve": ("multicity", "serve", False),
+    "scaled/train": ("scaled", "train", True),
+    "scaled/serve": ("scaled", "serve", True),
+    "branchpar/train": ("branchpar", "train", False),
+    "branchpar/serve": ("branchpar", "serve", False),
+    "bandedbranch/train": ("bandedbranch", "train", True),
+    "bandedbranch/serve": ("bandedbranch", "serve", True),
+}
+
+_ITEMSIZE = 4  # probe programs run float32 (dryrun parity)
+_PSUM_SLACK_BYTES = 4096  # loss/count scalars riding the dp sync
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    """One lowered probe program: compiled collectives + wire meta."""
+
+    name: str
+    ops: List[CollectiveOp]
+    while_count: int
+    mesh_shape: Tuple[int, ...]
+    axis_names: Tuple[str, ...]
+    #: analytic-model inputs: ``param_bytes``, and for banded programs
+    #: ``halo``/``b_local``/``m_local``/``f_cap``
+    meta: dict
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(op.out_bytes for op in self.ops)
+
+
+def declared_manifests() -> Dict[str, "object"]:
+    """Every probe program's declared manifest — pure config, no JAX.
+
+    This is what ``dryrun_multichip`` persists into the ``MULTICHIP_r*``
+    record so future on-chip runs can diff compiled reality against the
+    same declarations this pass checks statically.
+    """
+    from stmgcn_tpu.config import preset
+    from stmgcn_tpu.parallel.manifest import manifest_for_config
+
+    return {
+        name: manifest_for_config(preset(p), program=kind, banded=banded)
+        for name, (p, kind, banded) in PROGRAM_SPECS.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# probe program construction (cached; one lowering per program, shared by
+# every rule and by the lint-gate summary)
+# ---------------------------------------------------------------------------
+
+_REPORT_CACHE: Optional[Dict[str, ProgramReport]] = None
+
+
+def _band_adj(n: int, w: int, seed: int):
+    """Symmetric adjacency with every edge within index distance ``w``."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    a = np.zeros((n, n), np.float32)
+    for d in range(1, w + 1):
+        band = (rng.random(n - d) < 0.7).astype(np.float32)
+        a += np.diag(band, d) + np.diag(band, -d)
+    return a
+
+
+def _abstract_state(tree, mesh):
+    """ShapeDtypeStructs with the state placement's shardings attached.
+
+    Mirrors :meth:`MeshPlacement.put(kind="state")` — replicated except
+    the vmapped ``branches`` subtree's leading axis over ``branch`` —
+    without materializing a single parameter: the probe only lowers.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.tree_util import DictKey, tree_map_with_path
+
+    has_branch = "branch" in mesh.shape
+
+    def conv(path, leaf):
+        in_branches = has_branch and any(
+            isinstance(k, DictKey) and k.key == "branches" for k in path
+        )
+        spec = (
+            P("branch", *([None] * (len(leaf.shape) - 1)))
+            if in_branches and len(leaf.shape)
+            else P()
+        )
+        return jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
+        )
+
+    return tree_map_with_path(conv, tree)
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(
+        math.prod(l.shape) * l.dtype.itemsize for l in jax.tree.leaves(tree)
+    )
+
+
+def _lower_pair(
+    base: str, mesh, placement, model, supports, x, y, mask, meta: dict
+) -> Dict[str, ProgramReport]:
+    """Lower ``{base}/train`` and ``{base}/serve`` from abstract params."""
+    import jax
+    import numpy as np
+
+    from stmgcn_tpu.serving.engine import serve_bucket_fn
+    from stmgcn_tpu.train import make_optimizer, make_step_fns
+
+    sup_p = placement.put(supports, "supports")
+    x_p = placement.put(np.asarray(x), "x")
+    y_p = placement.put(np.asarray(y), "y")
+    mask_p = placement.put(np.asarray(mask), "mask")
+    fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
+    params_s, opt_s = jax.eval_shape(fns.init, jax.random.key(0), sup_p, x_p)
+    params_a = _abstract_state(params_s, mesh)
+    opt_a = _abstract_state(opt_s, mesh)
+    meta = dict(meta, param_bytes=_tree_bytes(params_s))
+
+    shape = tuple(mesh.devices.shape)
+    names = tuple(mesh.axis_names)
+    out: Dict[str, ProgramReport] = {}
+
+    txt = (
+        fns.train_step.lower(params_a, opt_a, sup_p, x_p, y_p, mask_p)
+        .compile()
+        .as_text()
+    )
+    ops, loops = collect_collectives(txt, shape, names)
+    out[f"{base}/train"] = ProgramReport(
+        f"{base}/train", ops, loops, shape, names, meta
+    )
+
+    # bind the factory result first: serve_bucket_fn itself is never the
+    # jitted callable, so it must not become a program-db jit root here
+    serve_fwd = serve_bucket_fn(model)
+    serve = jax.jit(serve_fwd)
+    txt = serve.lower(params_a, sup_p, x_p).compile().as_text()
+    ops, loops = collect_collectives(txt, shape, names)
+    out[f"{base}/serve"] = ProgramReport(
+        f"{base}/serve", ops, loops, shape, names, meta
+    )
+    return out
+
+
+def _probe_dense(base: str, dp: int, branch: int, M: int) -> Dict[str, ProgramReport]:
+    """Dense-GSPMD probe (dp and dp x branch plans): no region sharding,
+    tiny synthetic operands — support values are irrelevant to the
+    lowered communication structure."""
+    import numpy as np
+
+    from stmgcn_tpu.models import STMGCN
+    from stmgcn_tpu.parallel import MeshPlacement, build_mesh
+
+    rng = np.random.default_rng(0)
+    N, B, T = 16, 2 * dp, 3
+    mesh = build_mesh(dp=dp, region=1, branch=branch)
+    placement = MeshPlacement(mesh)
+    model = STMGCN(
+        m_graphs=M, n_supports=2, seq_len=T, input_dim=1,
+        lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8,
+    )
+    sup = rng.normal(size=(M, 2, N, N)).astype(np.float32) * 0.1
+    x = rng.standard_normal((B, T, N, 1)).astype(np.float32)
+    y = (rng.standard_normal((B, N, 1)) * 0.1).astype(np.float32)
+    mask = np.ones(B, np.float32)
+    return _lower_pair(base, mesh, placement, model, sup, x, y, mask, {})
+
+
+def _probe_routed(base: str) -> Dict[str, ProgramReport]:
+    """Banded probes through the *real* routing path: ``build_dataset``
+    + ``route_supports`` + ``build_model``, dryrun-style shrinks.
+
+    ``scaled``: 32x2 grid so the cheb-K2 grid branch fits the halo
+    budget (bandwidth 4 <= n_local // 2 = 4) while the random transport/
+    similarity branches rightly stay dense — the preset's mixed plan.
+    ``bandedbranch``: banded city adjacencies stand in for the synthetic
+    transport graph (which no ordering bands — see the preset docstring);
+    with every branch within budget, routing produces the branch-stacked
+    strips whose engaged composition the manifest declares.
+    """
+    import numpy as np
+
+    from stmgcn_tpu.config import preset
+    from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
+    from stmgcn_tpu.parallel import MeshPlacement, ShardSpec, build_mesh
+
+    cfg = preset(base)
+    cfg.model.lstm_hidden_dim = 8
+    cfg.model.lstm_num_layers = 1
+    cfg.model.gcn_hidden_dim = 8
+    cfg.model.dtype = "float32"
+    if base == "scaled":
+        # 32x2 grid, cheb-K2: grid bandwidth K*cols = 4 <= n_local//2 = 4
+        # (the 50x50/K=3 original routes the same way at preset scale)
+        cfg.data.rows, cfg.data.cols = 32, 2
+        cfg.data.n_timesteps = 24 * 7 + 64
+        cfg.model.K = 2
+        cfg.train.batch_size = 2
+    else:  # bandedbranch
+        cfg.data.rows = 4
+        cfg.data.n_timesteps = 24 * 7 + 64
+        cfg.train.batch_size = 4
+        cfg.mesh.halo = 4
+    mesh = build_mesh(
+        dp=cfg.mesh.dp, region=cfg.mesh.region, branch=cfg.mesh.branch
+    )
+    placement = MeshPlacement(mesh)
+    dataset = build_dataset(cfg)
+    if base == "bandedbranch":
+        n = dataset.n_nodes
+        dataset.adjs = {"g0": _band_adj(n, 1, 1), "g1": _band_adj(n, 2, 2)}
+    supports, modes = route_supports(cfg, dataset)
+    if modes is None or "banded" not in modes:
+        raise RuntimeError(
+            f"spmd probe {base!r}: routing did not engage the banded plan "
+            f"(modes={modes}) — the probe shrink no longer matches the "
+            "router's bandwidth budget"
+        )
+    model = build_model(cfg, dataset.n_feats, modes, ShardSpec(mesh=mesh))
+    batch = next(
+        dataset.batches("train", cfg.train.batch_size, pad_last=True)
+    )
+    mask = (np.arange(len(batch)) < batch.n_real).astype(np.float32)
+    banded = [s for s in (supports if isinstance(supports, tuple) else (supports,))
+              if hasattr(s, "halo")]
+    halo = max(s.halo for s in banded)
+    m_local = max(1, cfg.model.m_graphs // cfg.mesh.branch)
+    f_cap = (
+        cfg.data.serial_len + cfg.data.daily_len + cfg.data.weekly_len
+        + 2 * cfg.model.lstm_hidden_dim + cfg.model.gcn_hidden_dim
+    )
+    meta = {
+        "halo": halo,
+        "b_local": cfg.train.batch_size // cfg.mesh.dp,
+        "m_local": m_local,
+        "f_cap": f_cap,
+    }
+    return _lower_pair(
+        base, mesh, placement, model, supports, batch.x, batch.y, mask, meta
+    )
+
+
+def _lower_programs() -> Dict[str, ProgramReport]:
+    """All probe programs, lowered once per process and cached."""
+    global _REPORT_CACHE
+    if _REPORT_CACHE is not None:
+        return _REPORT_CACHE
+    import jax
+
+    need = max(
+        math.prod(_preset_mesh(p)) for p, _, _ in PROGRAM_SPECS.values()
+    )
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"spmd contract pass needs {need} devices to lower the probe "
+            f"programs, found {len(jax.devices())} — call "
+            "force_host_platform('cpu', n_devices=8) before any JAX use "
+            "(stmgcn lint and tests/conftest.py do)"
+        )
+    reports: Dict[str, ProgramReport] = {}
+    reports.update(_probe_dense("multicity", dp=8, branch=1, M=2))
+    reports.update(_probe_routed("scaled"))
+    reports.update(_probe_dense("branchpar", dp=2, branch=3, M=3))
+    reports.update(_probe_routed("bandedbranch"))
+    missing = set(PROGRAM_SPECS) - set(reports)
+    if missing:
+        raise RuntimeError(f"spmd probes built no program for {sorted(missing)}")
+    _REPORT_CACHE = reports
+    return reports
+
+
+def _preset_mesh(preset_name: str) -> Tuple[int, ...]:
+    from stmgcn_tpu.config import preset
+
+    m = preset(preset_name).mesh
+    return (m.dp, m.region, m.branch)
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _emit(findings: List[Finding], rule: str, name: str, message: str) -> None:
+    findings.append(
+        Finding(
+            rule=rule,
+            path=f"<contract:spmd:{name}>",
+            line=0,
+            message=message,
+            severity=RULES[rule].severity,
+        )
+    )
+
+
+def analyze_program(
+    name: str,
+    hlo_text: str,
+    manifest,
+    mesh_shape: Iterable[int],
+    axis_names: Iterable[str],
+    meta: Optional[dict] = None,
+    budget: Optional[int] = None,
+) -> List[Finding]:
+    """Manifest + wire findings for one compiled module (testable core).
+
+    ``meta`` carries the analytic-model inputs (``halo``/``b_local``/
+    ``m_local``/``f_cap`` for the halo bound, ``param_bytes`` for the dp
+    psum bound); ``budget`` is the program's total-bytes ceiling. Either
+    may be omitted to check manifest structure alone.
+    """
+    ops, while_count = collect_collectives(
+        hlo_text, tuple(mesh_shape), tuple(axis_names)
+    )
+    rep = ProgramReport(
+        name, ops, while_count, tuple(mesh_shape), tuple(axis_names),
+        dict(meta or {}),
+    )
+    return _manifest_findings(rep, manifest) + _wire_findings(rep, budget)
+
+
+def _manifest_findings(rep: ProgramReport, manifest) -> List[Finding]:
+    findings: List[Finding] = []
+    by_sig: Dict[Tuple[str, str], List[CollectiveOp]] = {}
+    for op in rep.ops:
+        by_sig.setdefault((op.kind, op.axes), []).append(op)
+    for (kind, axes), ops in sorted(by_sig.items()):
+        decl = manifest.lookup(kind, axes)
+        if decl is None:
+            names = ", ".join(f"%{o.name}" for o in ops[:3])
+            findings_msg = (
+                f"{rep.name}: compiled program contains {len(ops)} "
+                f"undeclared {kind} over mesh axes '{axes}' ({names}"
+                f"{', ...' if len(ops) > 3 else ''}, "
+                f"{sum(o.out_bytes for o in ops):,} bytes) — implicit "
+                "GSPMD resharding the plan never declared; fix the "
+                "operand shardings, or declare it in the plan's "
+                "CollectiveManifest fragment (parallel/manifest.py) if "
+                "the movement is intended"
+            )
+            _emit(findings, "spmd-collective-manifest", rep.name, findings_msg)
+            continue
+        if decl.max_count is not None and len(ops) > decl.max_count:
+            _emit(
+                findings, "spmd-collective-manifest", rep.name,
+                f"{rep.name}: {len(ops)} {kind} ops over '{axes}' exceed "
+                f"the declared max_count {decl.max_count} — the program's "
+                "communication structure drifted; re-derive the manifest "
+                "or fix the regression",
+            )
+    for decl in manifest.decls:
+        if decl.required and (decl.kind, decl.axes) not in by_sig:
+            _emit(
+                findings, "spmd-collective-manifest", rep.name,
+                f"{rep.name}: declared {decl.kind} over '{decl.axes}' "
+                f"({decl.reason or 'required by the plan'}) never appears "
+                "in the compiled program — the plan did not engage "
+                "(routing fell back, or the sharded operands were "
+                "replicated before the op)",
+            )
+    return findings
+
+
+def _wire_findings(
+    rep: ProgramReport, budget: Optional[int]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    meta = rep.meta
+    if budget is not None and rep.total_bytes > budget:
+        _emit(
+            findings, "spmd-wire-budget", rep.name,
+            f"{rep.name}: compiled program moves {rep.total_bytes:,} "
+            f"collective output bytes, over the budget {budget:,} "
+            "(measured x ~2 headroom) — a real wire regression needs "
+            "`stmgcn lint --rebaseline` to re-baseline deliberately",
+        )
+    if "halo" in meta:
+        cap = (
+            meta["halo"] * meta["b_local"] * meta["m_local"]
+            * meta["f_cap"] * _ITEMSIZE
+        )
+        for op in rep.ops:
+            if op.kind == "collective-permute" and op.out_bytes > cap:
+                _emit(
+                    findings, "spmd-wire-budget", rep.name,
+                    f"{rep.name}: halo permute %{op.name} moves "
+                    f"{op.out_bytes:,} bytes, over the boundary-rows bound "
+                    f"{cap:,} (halo {meta['halo']} x B_local "
+                    f"{meta['b_local']} x M_local {meta['m_local']} x "
+                    f"F_cap {meta['f_cap']} x {_ITEMSIZE}) — the exchange "
+                    "is moving more than boundary rows, which erases the "
+                    "banded plan's N/(2·halo)x wire reduction",
+                )
+    if "param_bytes" in meta and any(
+        op.kind == "all-reduce" and op.axes == "dp" for op in rep.ops
+    ):
+        dp_bytes = sum(
+            op.out_bytes
+            for op in rep.ops
+            if op.kind == "all-reduce" and op.axes == "dp"
+        )
+        cap = 2 * meta["param_bytes"] + _PSUM_SLACK_BYTES
+        if dp_bytes > cap:
+            _emit(
+                findings, "spmd-wire-budget", rep.name,
+                f"{rep.name}: dp all-reduce traffic {dp_bytes:,} bytes "
+                f"exceeds the gradient-psum model 2 x param_bytes "
+                f"({meta['param_bytes']:,}) + {_PSUM_SLACK_BYTES} — "
+                "something beyond gradients/loss is syncing over dp "
+                "(likely an activation replicated the wrong way)",
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# per-device footprint math (pure config, preset-scale — no lowering)
+# ---------------------------------------------------------------------------
+
+
+def estimate_shard_footprint(cfg) -> dict:
+    """Per-device operand bytes for a config's sharded training step.
+
+    The ``resident-memory`` arithmetic extended to mesh shards: supports
+    (dense row-shards over ``region`` and graph-shards over ``branch``,
+    or banded strips ``n_local x (n_local + 2·halo)`` when the halo plan
+    is forced) plus one streamed batch's ``x``/``y`` shard. Data arrays
+    are float32 regardless of compute dtype, as in ``resident_check``.
+    Pure config math — nothing is built.
+    """
+    from stmgcn_tpu.data.windowing import WindowSpec
+
+    d, mesh = cfg.data, cfg.mesh
+    spec = WindowSpec(
+        d.serial_len, d.daily_len, d.weekly_len, d.day_timesteps,
+        horizon=d.horizon,
+    )
+    cols = d.cols if d.cols is not None else d.rows
+    if d.city_rows is not None:
+        city_nodes = [r * r for r in d.city_rows]
+    else:
+        city_nodes = [d.rows * cols] * max(1, d.n_cities)
+    ksup = cfg.model.n_supports
+    m_local = max(1, cfg.model.m_graphs // mesh.branch)
+    region = mesh.region
+    supports_bytes = 0
+    for n in city_nodes:
+        n_pad = -(-n // region) * region
+        n_local = n_pad // region
+        if mesh.region_strategy == "banded" and region > 1:
+            halo = min(
+                mesh.halo if mesh.halo is not None else n_local // 2, n_local
+            )
+            supports_bytes += (
+                m_local * ksup * n_local * (n_local + 2 * halo) * _ITEMSIZE
+            )
+        else:
+            # dense row shard (GSPMD / auto's worst case: auto may route
+            # every branch dense)
+            supports_bytes += m_local * ksup * n_local * n_pad * _ITEMSIZE
+    n_max = max(city_nodes)
+    n_pad = -(-n_max // region) * region
+    b_local = -(-cfg.train.batch_size // mesh.dp)
+    x_bytes = b_local * spec.seq_len * (n_pad // region) * _ITEMSIZE
+    y_bytes = b_local * max(1, d.horizon) * (n_pad // region) * _ITEMSIZE
+    total = supports_bytes + x_bytes + y_bytes
+    return {
+        "supports_bytes": supports_bytes,
+        "batch_bytes": x_bytes + y_bytes,
+        "total_bytes": total,
+    }
+
+
+def check_shard_footprints(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+    budget_bytes: Optional[int] = None,
+) -> List[Finding]:
+    """Per-device operand footprint vs the per-core budget, every
+    multi-device preset. Single-device residency is ``resident-memory``'s
+    domain; this rule owns the sharded extension."""
+    from stmgcn_tpu.config import PRESETS
+    from stmgcn_tpu.train.trainer import Trainer
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+    if budget_bytes is None:
+        budget_bytes = Trainer.RESIDENT_CAP_BYTES
+
+    findings: List[Finding] = []
+    for name, cfg in configs:
+        if cfg.mesh.n_devices <= 1:
+            continue
+        est = estimate_shard_footprint(cfg)
+        if est["total_bytes"] > budget_bytes:
+            _emit(
+                findings, "spmd-shard-footprint", name,
+                f"{name}: per-device sharded operands need "
+                f"{est['total_bytes']:,} bytes (supports "
+                f"{est['supports_bytes']:,} + batch {est['batch_bytes']:,}) "
+                f"but the per-core budget is {budget_bytes:,} — the step "
+                "OOMs on every device at once; raise region/branch "
+                "extents, shrink the batch, or band the supports",
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def check_spmd_contracts(
+    budgets: Optional[Dict[str, int]] = None,
+) -> List[Finding]:
+    """The full pass: coverage + manifest + wire for every probe program,
+    then preset-scale footprints. One (cached) lowering per program."""
+    from stmgcn_tpu.config import PRESETS
+
+    budgets = WIRE_BUDGETS if budgets is None else budgets
+    findings: List[Finding] = []
+    covered = {p for p, _, _ in PROGRAM_SPECS.values()}
+    for name, build in PRESETS.items():
+        if build().mesh.n_devices > 1 and name not in covered:
+            _emit(
+                findings, "spmd-collective-manifest", name,
+                f"{name}: multi-device preset has no spmd probe program — "
+                "add it to analysis/spmd_check.PROGRAM_SPECS so its "
+                "compiled collectives are checked against a manifest",
+            )
+    manifests = declared_manifests()
+    for name, rep in _lower_programs().items():
+        findings.extend(_manifest_findings(rep, manifests[name]))
+        budget = budgets.get(name)
+        if budget is None:
+            _emit(
+                findings, "spmd-wire-budget", name,
+                f"{name}: no wire budget recorded — run "
+                "`stmgcn lint --rebaseline` to measure and pin it",
+            )
+        findings.extend(_wire_findings(rep, budget))
+    findings.extend(check_shard_footprints())
+    return findings
+
+
+def spmd_summary() -> dict:
+    """The lint-gate section: programs checked / collectives observed /
+    unsuppressed findings (0 programs or any finding fails the gate)."""
+    reports = _lower_programs()
+    findings = check_spmd_contracts()
+    return {
+        "programs": len(reports),
+        "collectives": sum(len(r.ops) for r in reports.values()),
+        "findings": sum(1 for f in findings if not f.suppressed),
+    }
+
+
+def measured_wire_totals() -> Dict[str, int]:
+    return {n: r.total_bytes for n, r in _lower_programs().items()}
+
+
+def rebaseline_wire(
+    path: Optional[str] = None, headroom: float = 2.0
+) -> dict:
+    """Measure per-program wire totals and rewrite :data:`WIRE_BUDGETS`.
+
+    Same contract as the jaxpr primitive rebaseline: measured x
+    ``headroom`` (the standing ~2x policy), rounded up to the next KiB,
+    rewritten into this module's single-line literal (``path`` overrides
+    for tests) and updated in-process.
+    """
+    if headroom < 1.0:
+        raise ValueError(f"headroom must be >= 1.0, got {headroom}")
+    totals = measured_wire_totals()
+    budgets = {
+        name: max(1024, int(math.ceil(t * headroom / 1024.0) * 1024))
+        for name, t in totals.items()
+    }
+    path = path or __file__
+    with open(path) as f:
+        src = f.read()
+    literal = "{" + ", ".join(f'"{k}": {v}' for k, v in budgets.items()) + "}"
+    new_src, n_subs = re.subn(
+        r"WIRE_BUDGETS = \{[^}]*\}",
+        "WIRE_BUDGETS = " + literal,
+        src,
+        count=1,
+    )
+    if n_subs != 1:
+        raise RuntimeError(f"could not find WIRE_BUDGETS literal in {path}")
+    with open(path, "w") as f:
+        f.write(new_src)
+    WIRE_BUDGETS.clear()
+    WIRE_BUDGETS.update(budgets)
+    return {"totals": totals, "budgets": budgets, "path": path}

@@ -31,6 +31,11 @@ from stmgcn_tpu.parallel.banded import (
     strip_decompose,
 )
 from stmgcn_tpu.parallel.halo import halo_exchange
+from stmgcn_tpu.parallel.manifest import (
+    CollectiveDecl,
+    CollectiveManifest,
+    manifest_for_config,
+)
 from stmgcn_tpu.parallel.mesh import build_mesh, init_distributed, mesh_from_config
 from stmgcn_tpu.parallel.placement import MeshPlacement
 from stmgcn_tpu.parallel.sparse import (
@@ -43,6 +48,9 @@ from stmgcn_tpu.parallel.sparse import (
 __all__ = [
     "BandedSpec",
     "BandedSupports",
+    "CollectiveDecl",
+    "CollectiveManifest",
+    "manifest_for_config",
     "MeshPlacement",
     "ShardSpec",
     "ShardedBlockSparse",

@@ -58,6 +58,26 @@ class ShardSpec:
 BandedSpec = ShardSpec
 
 
+def _halo_exchange_decls():
+    from stmgcn_tpu.parallel.manifest import CollectiveDecl
+
+    return (
+        CollectiveDecl(
+            kind="collective-permute", axes="region", required=True,
+            reason="±1 ring halo exchange of boundary signal rows "
+            "(halo_exchange) — the op that replaces GSPMD's full "
+            "node-axis gather",
+        ),
+    )
+
+
+#: collective signature of the halo plan: boundary rows ride ``ppermute``
+#: over the ring — the plan-defining op a banded program must contain
+#: (its absence means routing silently fell back to dense GSPMD)
+HALO_EXCHANGE = _halo_exchange_decls()
+__all__.append("HALO_EXCHANGE")
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class BandedSupports:

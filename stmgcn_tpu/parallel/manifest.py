@@ -1,0 +1,126 @@
+"""Declared collective manifests: what a sharding plan promises to move.
+
+Every parallel plan in this package implies a communication signature —
+the data-parallel placement psums gradients over ``dp``, the banded halo
+plan ring-permutes boundary rows over ``region``, GSPMD's dense region
+sharding all-gathers the node axis, branch model parallelism psums the
+fusion over ``branch``. A :class:`CollectiveManifest` writes that
+signature down as data: the collective kinds and mesh axes a compiled
+step program is *allowed* (and, for the plan-defining ones, *required*)
+to contain.
+
+The declarations live as fragment tuples next to the code they describe
+(``placement.DP_GRAD_SYNC``, ``banded.HALO_EXCHANGE``, ...);
+:func:`manifest_for_config` composes a config's fragments into the
+per-program manifest the :mod:`stmgcn_tpu.analysis.spmd_check` contract
+pass diffs against the compiled HLO. An observed collective with no
+matching declaration is implicit GSPMD resharding the plan never asked
+for; a required declaration with no observed op means the plan never
+engaged — both are ``spmd-collective-manifest`` errors.
+
+``max_count`` bounds the *static* op count in the compiled module
+(``None`` = unbounded): collectives inside an HLO ``while`` body count
+once, so the bound is per-program structure, not per-step wire volume —
+bytes are budgeted separately (``spmd-wire-budget``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+__all__ = ["CollectiveDecl", "CollectiveManifest", "manifest_for_config"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveDecl:
+    """One permitted collective: HLO kind x mesh axes (``"+"``-joined).
+
+    ``required=True`` marks a plan-defining op — its absence from the
+    compiled program means the plan silently never engaged (e.g. the
+    banded path fell back to dense GSPMD).
+    """
+
+    kind: str
+    axes: str
+    required: bool = False
+    max_count: Optional[int] = None
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveManifest:
+    """The full declared signature of one compiled program."""
+
+    program: str
+    decls: Tuple[CollectiveDecl, ...]
+
+    def lookup(self, kind: str, axes: str) -> Optional[CollectiveDecl]:
+        for d in self.decls:
+            if d.kind == kind and d.axes == axes:
+                return d
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "program": self.program,
+            "decls": [d.to_dict() for d in self.decls],
+        }
+
+
+def manifest_for_config(
+    cfg, program: str = "train", banded: bool = False
+) -> CollectiveManifest:
+    """Compose a config's plan fragments into one program manifest.
+
+    ``program`` is ``"train"`` (grads + optimizer: every axis the loss
+    and parameters span syncs) or ``"serve"`` (forward only: no gradient
+    traffic; a ``dp``-only mesh serves with *zero* collectives, and any
+    observed op is implicit resharding). ``banded=True`` declares the
+    explicit halo plan for the region axis — permutes required — which
+    is exactly when routing produced banded strips; otherwise a
+    ``region`` axis gets GSPMD's dense signature (node all-gathers).
+    """
+    from stmgcn_tpu.parallel.banded import HALO_EXCHANGE
+    from stmgcn_tpu.parallel.placement import (
+        BRANCH_FUSION,
+        DP_GRAD_SYNC,
+        GSPMD_REGION,
+    )
+
+    train = program == "train"
+    decls: list = []
+    if cfg.mesh.dp > 1 and train:
+        decls.extend(DP_GRAD_SYNC)
+    if cfg.mesh.region > 1:
+        if banded:
+            decls.extend(HALO_EXCHANGE)
+        # dense-branch signal gathers (and, in banded programs, the
+        # backward-pass transposes and node-pooling reductions) ride
+        # GSPMD's region signature either way
+        decls.extend(
+            dataclasses.replace(d, required=d.required and not banded)
+            for d in GSPMD_REGION
+        )
+        decls.append(
+            CollectiveDecl(
+                "all-reduce", "region", required=False,
+                reason="node-pooling (gate context) and, in training, "
+                "loss-mean / weight-grad reductions over the "
+                "region-sharded node axis",
+            )
+        )
+    if cfg.mesh.branch > 1:
+        decls.extend(BRANCH_FUSION)
+        if train:
+            decls.append(
+                CollectiveDecl(
+                    "all-gather", "branch", required=False,
+                    reason="optimizer re-gather of branch-sharded "
+                    "parameter updates",
+                )
+            )
+    return CollectiveManifest(program=program, decls=tuple(decls))

@@ -31,7 +31,38 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["MeshPlacement"]
+__all__ = ["BRANCH_FUSION", "DP_GRAD_SYNC", "GSPMD_REGION", "MeshPlacement"]
+
+
+def _decl(kind, axes, required=False, reason=""):
+    from stmgcn_tpu.parallel.manifest import CollectiveDecl
+
+    return CollectiveDecl(kind=kind, axes=axes, required=required, reason=reason)
+
+
+#: collective signature of the data-parallel placement: with batches
+#: split over ``dp`` and params replicated, GSPMD syncs gradients and the
+#: loss mean with ``all-reduce`` over ``dp`` — the plan-defining op of
+#: every ``dp > 1`` training program (see :mod:`.manifest`)
+DP_GRAD_SYNC = (
+    _decl("all-reduce", "dp", required=True,
+          reason="gradient + loss-mean psum over the batch axis"),
+)
+
+#: collective signature of dense region sharding: each graph conv's
+#: node-axis contraction all-gathers the signal over ``region``
+GSPMD_REGION = (
+    _decl("all-gather", "region", required=True,
+          reason="node-axis signal gather in the dense graph convs"),
+)
+
+#: collective signature of branch model parallelism: the branch-fusion
+#: sum (and replicated-param grad sync) is an ``all-reduce`` over
+#: ``branch``
+BRANCH_FUSION = (
+    _decl("all-reduce", "branch", required=True,
+          reason="branch-fusion psum / replicated-param grad sync"),
+)
 
 
 class MeshPlacement:

@@ -1,0 +1,473 @@
+"""SPMD contract pass: HLO collective extraction, manifests, and the
+three spmd rules (collective-manifest / wire-budget / shard-footprint).
+
+Layers, cheapest first:
+
+- :mod:`stmgcn_tpu.analysis.hlo` parsing/attribution on synthetic HLO
+  lines in the exact syntaxes XLA prints on this image (iota replica
+  groups with transposes, explicit groups, async ``-start`` tuples,
+  ``source_target_pairs``);
+- manifest composition (:func:`manifest_for_config`) — pure config;
+- a pinned **fire/pass boundary pair per rule** through
+  :func:`analyze_program` on hand-built HLO text (no JAX);
+- the seeded regression: a real jit-compiled program whose output
+  sharding mis-spec forces GSPMD to insert an implicit all-gather, which
+  the pass must catch *statically* on the CPU-only host — naming the
+  HLO op and the mesh axis — while the corrected twin passes clean;
+- slow tier: the whole-tree zero-findings pin over every probe program.
+"""
+
+import json
+
+import pytest
+
+from stmgcn_tpu.analysis.hlo import collect_collectives, infer_axes
+from stmgcn_tpu.analysis.spmd_check import (
+    PROGRAM_SPECS,
+    WIRE_BUDGETS,
+    analyze_program,
+    check_shard_footprints,
+    estimate_shard_footprint,
+)
+from stmgcn_tpu.config import preset
+from stmgcn_tpu.parallel.manifest import (
+    CollectiveDecl,
+    CollectiveManifest,
+    manifest_for_config,
+)
+
+MESH_2x4 = ((2, 4), ("dp", "region"))
+MESH_2x2x2 = ((2, 2, 2), ("dp", "region", "branch"))
+
+
+class TestInferAxes:
+    """Axis attribution from replica_groups / source_target_pairs."""
+
+    def test_iota_groups_vary_trailing_axis(self):
+        # [2,4]<=[8]: rows are {0..3},{4..7} — fix dp, vary region
+        line = "all-gather(...), replica_groups=[2,4]<=[8]"
+        assert infer_axes(line, *MESH_2x4) == "region"
+
+    def test_iota_transpose_varies_leading_axis(self):
+        # [4,2]<=[2,4]T(1,0): groups {0,4},{1,5},... — vary dp
+        line = "all-reduce(...), replica_groups=[4,2]<=[2,4]T(1,0)"
+        assert infer_axes(line, *MESH_2x4) == "dp"
+
+    def test_explicit_groups(self):
+        line = "all-reduce(...), replica_groups={{0,4},{1,5},{2,6},{3,7}}"
+        assert infer_axes(line, *MESH_2x4) == "dp"
+
+    def test_empty_groups_span_all_axes(self):
+        line = "all-reduce(...), replica_groups={}"
+        assert infer_axes(line, *MESH_2x4) == "dp+region"
+
+    def test_branch_axis_on_3d_mesh(self):
+        # (dp, region, branch) row-major: branch is the fastest axis
+        line = "all-reduce(...), replica_groups={{0,1},{2,3},{4,5},{6,7}}"
+        assert infer_axes(line, *MESH_2x2x2) == "branch"
+
+    def test_permute_pairs_single_axis(self):
+        line = (
+            "collective-permute(...), "
+            "source_target_pairs={{0,1},{1,2},{2,3},{3,0},{4,5},{5,6},{6,7},{7,4}}"
+        )
+        assert infer_axes(line, *MESH_2x4) == "region"
+
+    def test_pair_crossing_two_axes_is_unattributable(self):
+        line = "collective-permute(...), source_target_pairs={{0,5}}"
+        assert infer_axes(line, *MESH_2x4) == "?"
+
+    def test_grouping_matching_no_partition_is_unattributable(self):
+        line = "all-reduce(...), replica_groups={{0,3},{1,2},{4,7},{5,6}}"
+        assert infer_axes(line, *MESH_2x4) == "?"
+
+    def test_singleton_groups_are_degenerate(self):
+        # extent-1 axis partition: no device talks to any other
+        line = (
+            "all-reduce(...), "
+            "replica_groups={{0},{1},{2},{3},{4},{5},{6},{7}}"
+        )
+        assert infer_axes(line, *MESH_2x4) == ""
+
+
+class TestCollectCollectives:
+    def test_bytes_and_async_pairs_count_once(self):
+        hlo = "\n".join([
+            "  %all-gather.1 = f32[4,16]{1,0} all-gather(%p0), "
+            "replica_groups=[2,4]<=[8], dimensions={1}",
+            "  %all-reduce-start.2 = (f32[8,8], f32[8,8], u32[]) "
+            "all-reduce-start(%x), replica_groups=[4,2]<=[2,4]T(1,0)",
+            "  %all-reduce-done.2 = f32[8,8] all-reduce-done("
+            "%all-reduce-start.2)",
+        ])
+        ops, n_while = collect_collectives(hlo, *MESH_2x4)
+        assert n_while == 0
+        assert [(o.kind, o.axes, o.out_bytes) for o in ops] == [
+            ("all-gather", "region", 4 * 16 * 4),
+            # start tuple: scalar u32[] dropped, last nonscalar counted once
+            ("all-reduce", "dp", 8 * 8 * 4),
+        ]
+
+    def test_degenerate_singleton_ops_are_dropped(self):
+        hlo = (
+            "  %all-reduce.9 = f32[4]{0} all-reduce(%x), "
+            "replica_groups={{0},{1},{2},{3},{4},{5},{6},{7}}"
+        )
+        ops, _ = collect_collectives(hlo, *MESH_2x4)
+        assert ops == []
+
+    def test_while_counted(self):
+        hlo = "  %w = (s32[], f32[4]) while(%init), condition=%c, body=%b"
+        ops, n_while = collect_collectives(hlo, *MESH_2x4)
+        assert ops == [] and n_while == 1
+
+
+class TestManifestComposition:
+    def test_dp_only_train_vs_serve(self):
+        cfg = preset("multicity")
+        train = manifest_for_config(cfg, program="train")
+        assert train.lookup("all-reduce", "dp").required
+        # a dp-only mesh serves with zero collectives: empty manifest
+        serve = manifest_for_config(cfg, program="serve")
+        assert serve.decls == ()
+
+    def test_banded_flips_required_ops(self):
+        cfg = preset("scaled")
+        dense = manifest_for_config(cfg, program="train", banded=False)
+        assert dense.lookup("all-gather", "region").required
+        assert dense.lookup("collective-permute", "region") is None
+        banded = manifest_for_config(cfg, program="train", banded=True)
+        assert banded.lookup("collective-permute", "region").required
+        # region gathers still happen in banded programs (backward
+        # transposes, pooling) — declared, but no longer plan-defining
+        assert banded.lookup("all-gather", "region").required is False
+
+    def test_branch_axis_declares_fusion_psum(self):
+        cfg = preset("branchpar")
+        m = manifest_for_config(cfg, program="serve")
+        assert m.lookup("all-reduce", "branch").required
+        assert m.lookup("all-reduce", "dp") is None  # no grads in serve
+
+    def test_to_dict_round_trips_decl_fields(self):
+        m = manifest_for_config(preset("bandedbranch"), banded=True)
+        d = m.to_dict()
+        assert d["program"] == "train"
+        kinds = {(x["kind"], x["axes"]) for x in d["decls"]}
+        assert ("collective-permute", "region") in kinds
+        assert ("all-reduce", "branch") in kinds
+        assert all(
+            set(x) == {"kind", "axes", "required", "max_count", "reason"}
+            for x in d["decls"]
+        )
+
+
+def _m(*decls):
+    return CollectiveManifest(program="t", decls=tuple(decls))
+
+
+_AG_REGION = (
+    "  %all-gather.7 = f32[4,16]{1,0} all-gather(%p0), "
+    "replica_groups=[2,4]<=[8], dimensions={1}"
+)
+_PERMUTE = (
+    "  %collective-permute.3 = f32[2,2,8]{2,1,0} collective-permute(%x), "
+    "source_target_pairs={{0,1},{1,2},{2,3},{3,0},{4,5},{5,6},{6,7},{7,4}}"
+)
+_AR_DP = (
+    "  %all-reduce.5 = f32[64]{0} all-reduce(%g), "
+    "replica_groups=[4,2]<=[2,4]T(1,0)"
+)
+
+
+class TestManifestRuleBoundaries:
+    """Pinned fire/pass boundary pair for spmd-collective-manifest."""
+
+    def test_undeclared_collective_fires_naming_op_and_axis(self):
+        f = analyze_program("p", _AG_REGION, _m(), *MESH_2x4)
+        assert [x.rule for x in f] == ["spmd-collective-manifest"]
+        assert f[0].severity == "error"
+        assert f[0].path == "<contract:spmd:p>"
+        assert "%all-gather.7" in f[0].message
+        assert "'region'" in f[0].message
+
+    def test_declared_collective_passes(self):
+        m = _m(CollectiveDecl("all-gather", "region"))
+        assert analyze_program("p", _AG_REGION, m, *MESH_2x4) == []
+
+    def test_required_missing_fires_and_present_passes(self):
+        m = _m(CollectiveDecl(
+            "collective-permute", "region", required=True, reason="halo"))
+        f = analyze_program("p", "", m, *MESH_2x4)
+        assert [x.rule for x in f] == ["spmd-collective-manifest"]
+        assert "never appears" in f[0].message and "halo" in f[0].message
+        assert analyze_program("p", _PERMUTE, m, *MESH_2x4) == []
+
+    def test_max_count_boundary(self):
+        m = _m(CollectiveDecl("all-gather", "region", max_count=1))
+        one = _AG_REGION
+        two = _AG_REGION + "\n" + _AG_REGION.replace(".7", ".8")
+        assert analyze_program("p", one, m, *MESH_2x4) == []
+        f = analyze_program("p", two, m, *MESH_2x4)
+        assert [x.rule for x in f] == ["spmd-collective-manifest"]
+        assert "max_count 1" in f[0].message
+
+
+class TestWireRuleBoundaries:
+    """Pinned fire/pass boundary pairs for spmd-wire-budget."""
+
+    _M = _m(
+        CollectiveDecl("all-gather", "region"),
+        CollectiveDecl("collective-permute", "region"),
+        CollectiveDecl("all-reduce", "dp"),
+    )
+
+    def test_total_bytes_budget_boundary(self):
+        nbytes = 4 * 16 * 4  # _AG_REGION's output
+        ok = analyze_program(
+            "p", _AG_REGION, self._M, *MESH_2x4, budget=nbytes)
+        assert ok == []
+        f = analyze_program(
+            "p", _AG_REGION, self._M, *MESH_2x4, budget=nbytes - 1)
+        assert [x.rule for x in f] == ["spmd-wire-budget"]
+        assert "rebaseline" in f[0].message
+
+    def test_halo_permute_bound_boundary(self):
+        # permute output 2*2*8*4 = 128 bytes; cap = halo*b*m*f_cap*4
+        meta = {"halo": 2, "b_local": 2, "m_local": 1, "f_cap": 8}
+        assert analyze_program(
+            "p", _PERMUTE, self._M, *MESH_2x4, meta=meta) == []
+        tight = dict(meta, f_cap=7)  # cap 112 < 128
+        f = analyze_program("p", _PERMUTE, self._M, *MESH_2x4, meta=tight)
+        assert [x.rule for x in f] == ["spmd-wire-budget"]
+        assert "boundary-rows bound" in f[0].message
+
+    def test_dp_psum_bound_boundary(self):
+        # dp all-reduce 256 bytes; cap = 2*param_bytes + 4096
+        from stmgcn_tpu.analysis import spmd_check as sc
+
+        slack = sc._PSUM_SLACK_BYTES
+        ok = {"param_bytes": (256 - slack + 1) // 2 + 1}
+        assert analyze_program(
+            "p", _AR_DP, self._M, *MESH_2x4, meta=ok) == []
+        over = _AR_DP + "\n" + _AR_DP.replace(".5", ".6").replace(
+            "f32[64]", "f32[9999]")
+        f = analyze_program(
+            "p", over, self._M, *MESH_2x4, meta={"param_bytes": 64})
+        assert [x.rule for x in f] == ["spmd-wire-budget"]
+        assert "gradient-psum model" in f[0].message
+
+
+class TestShardFootprint:
+    """spmd-shard-footprint: per-device operand math, pure config."""
+
+    def test_every_multi_device_preset_fits(self):
+        assert check_shard_footprints() == []
+
+    def test_estimate_scales_down_with_region(self):
+        cfg = preset("scaled")
+        whole = estimate_shard_footprint(cfg)
+        cfg2 = preset("scaled")
+        cfg2.mesh.region = 4
+        bigger = estimate_shard_footprint(cfg2)
+        # dense supports per device: n_local x n_pad — halving region
+        # roughly doubles the shard
+        assert bigger["supports_bytes"] > 1.5 * whole["supports_bytes"]
+
+    def test_banded_strips_beat_dense_shards(self):
+        # scaled: n_local=313, default halo 156 — strips 313 x 625 per
+        # support vs dense 313 x 2504: the banded plan's resident win
+        cfg = preset("scaled")
+        dense = estimate_shard_footprint(cfg)
+        cfg2 = preset("scaled")
+        cfg2.mesh.region_strategy = "banded"
+        banded = estimate_shard_footprint(cfg2)
+        assert banded["supports_bytes"] < 0.5 * dense["supports_bytes"]
+
+    def test_fire_pass_boundary_on_budget(self):
+        cfg = preset("branchpar")
+        total = estimate_shard_footprint(cfg)["total_bytes"]
+        assert check_shard_footprints([("b", cfg)], budget_bytes=total) == []
+        f = check_shard_footprints([("b", cfg)], budget_bytes=total - 1)
+        assert [x.rule for x in f] == ["spmd-shard-footprint"]
+        assert f[0].path == "<contract:spmd:b>"
+        assert "per-core budget" in f[0].message
+
+    def test_single_device_presets_out_of_scope(self):
+        cfg = preset("smoke")
+        assert cfg.mesh.n_devices == 1
+        # resident-memory owns single-device; even budget 0 stays silent
+        assert check_shard_footprints([("s", cfg)], budget_bytes=0) == []
+
+
+class TestSeededImplicitAllGather:
+    """The seeded regression ISSUE 15 names: a program whose output
+    sharding mis-spec makes GSPMD insert an implicit all-gather — caught
+    statically from the compiled module on the CPU-only host, with the
+    HLO op and the mesh axis in the message; the corrected twin passes.
+    """
+
+    @pytest.fixture()
+    def mesh(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from stmgcn_tpu.parallel import build_mesh
+
+        return build_mesh(dp=8, region=1)
+
+    def _compile(self, mesh, out_spec):
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jax.device_put(
+            np.zeros((16, 8), np.float32), NamedSharding(mesh, P("dp"))
+        )
+        fn = jax.jit(
+            lambda a: a * 2.0, out_shardings=NamedSharding(mesh, out_spec)
+        )
+        return fn.lower(x).compile().as_text()
+
+    def test_seeded_fire_and_corrected_pass(self, mesh):
+        from jax.sharding import PartitionSpec as P
+
+        manifest = _m()  # elementwise plan: NO collectives declared
+        shape, names = tuple(mesh.devices.shape), tuple(mesh.axis_names)
+
+        # mis-spec: replicated output of a dp-sharded operand — GSPMD
+        # must all-gather over dp to satisfy it
+        bad = self._compile(mesh, P())
+        findings = analyze_program("seeded", bad, manifest, shape, names)
+        assert [f.rule for f in findings] == ["spmd-collective-manifest"]
+        msg = findings[0].message
+        assert "undeclared all-gather" in msg
+        assert "'dp'" in msg
+        assert "%all-gather" in msg  # names the actual HLO op
+
+        # corrected twin: output keeps the operand's sharding — zero
+        # collectives, zero findings
+        good = self._compile(mesh, P("dp"))
+        assert analyze_program("fixed", good, manifest, shape, names) == []
+
+
+class TestDeclaredManifestsPureConfig:
+    def test_no_jax_needed_and_covers_all_probes(self):
+        from stmgcn_tpu.analysis.spmd_check import declared_manifests
+
+        ms = declared_manifests()
+        assert set(ms) == set(PROGRAM_SPECS)
+        # the dryrun-persisted shape is JSON-serializable as-is
+        blob = json.dumps({k: v.to_dict() for k, v in ms.items()})
+        assert "collective-permute" in blob
+
+    def test_wire_budgets_cover_all_probes(self):
+        assert set(WIRE_BUDGETS) == set(PROGRAM_SPECS)
+        assert all(v >= 1024 for v in WIRE_BUDGETS.values())
+
+
+@pytest.mark.slow
+class TestWholeTreePin:
+    """The zero-findings / zero-suppressions pin over the real probe
+    programs: every multi-device preset's train+serve lowered on the
+    virtual mesh, diffed against its manifest, within its wire budget."""
+
+    def test_all_probe_programs_clean(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from stmgcn_tpu.analysis.spmd_check import (
+            check_spmd_contracts,
+            spmd_summary,
+        )
+
+        assert check_spmd_contracts() == []
+        summary = spmd_summary()
+        assert summary["programs"] == len(PROGRAM_SPECS) == 8
+        assert summary["collectives"] > 0
+        assert summary["findings"] == 0
+
+    def test_banded_programs_contain_the_halo_permute(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from stmgcn_tpu.analysis.spmd_check import _lower_programs
+
+        reports = _lower_programs()
+        for name, (_, _, banded) in PROGRAM_SPECS.items():
+            kinds = {(o.kind, o.axes) for o in reports[name].ops}
+            if banded:
+                assert ("collective-permute", "region") in kinds, name
+        # dp training programs sync gradients
+        assert ("all-reduce", "dp") in {
+            (o.kind, o.axes) for o in reports["multicity/train"].ops
+        }
+
+
+class TestSarifRendering:
+    """Satellite a: SARIF 2.1.0 output — one document on stdout."""
+
+    def _findings(self):
+        from stmgcn_tpu.analysis.report import Finding
+
+        return [
+            Finding(
+                rule="spmd-collective-manifest",
+                path="<contract:spmd:p>",
+                line=0,
+                message="undeclared all-gather over 'region'",
+            ),
+            Finding(
+                rule="missing-donate", path="stmgcn_tpu/x.py", line=7,
+                message="no donate", col=3, severity="warning",
+                suppressed=True,
+            ),
+        ]
+
+    def test_document_shape(self):
+        from stmgcn_tpu.analysis.report import render_sarif
+
+        doc = json.loads(render_sarif(self._findings()))
+        assert doc["version"] == "2.1.0"
+        assert len(doc["runs"]) == 1
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "stmgcn-lint"
+        rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert rules == {"spmd-collective-manifest", "missing-donate"}
+        res = run["results"]
+        assert len(res) == 2
+        by_rule = {r["ruleId"]: r for r in res}
+        spmd = by_rule["spmd-collective-manifest"]
+        assert spmd["level"] == "error"
+        loc = spmd["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "<contract:spmd:p>"
+        assert loc["region"]["startLine"] == 1  # SARIF minimum, from line 0
+        sup = by_rule["missing-donate"]
+        assert sup["level"] == "warning"
+        assert sup["suppressions"] == [{"kind": "inSource"}]
+        # ruleIndex points into the driver rule table
+        for r in res:
+            assert run["tool"]["driver"]["rules"][r["ruleIndex"]]["id"] == (
+                r["ruleId"]
+            )
+
+    def test_cli_stdout_is_one_sarif_document(self):
+        """The stdout contract: `stmgcn lint --format sarif` prints
+        EXACTLY one JSON document (json.loads of the full stream), even
+        when clean."""
+        import os
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "stmgcn_tpu.cli", "lint",
+             "--format", "sarif", "--no-contracts"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        doc = json.loads(proc.stdout)  # whole stream parses as ONE doc
+        assert doc["version"] == "2.1.0"
+        assert doc["runs"][0]["results"] == []

@@ -518,6 +518,37 @@ def _health_rider() -> dict:
     }
 
 
+def _precision_rider() -> dict:
+    """Precision-census rider: the canonical train step dtype-walked
+    abstractly (no device execution) by the same engine ``stmgcn lint``
+    certifies the contract programs with — bytes/FLOPs by dtype, cast
+    count, classified-site count, and the parameter tree's dtype census
+    at the headline operating point. The record carries what the
+    hardware was actually asked to compute in, so a bf16 migration
+    shows up in the bench evidence as a census shift, not a footnote."""
+    import jax
+
+    from stmgcn_tpu.analysis.dtype_flow import flow_program
+    from stmgcn_tpu.models.params import leaf_dtype_census
+
+    operating = "bfloat16" if DTYPE == "bfloat16" else "float32"
+    fns, sup, x, y, mask, _ = build_canonical_step(
+        operating, unroll=LSTM_UNROLL, fused=LSTM_FUSED, backend="xla"
+    )
+    params, opt_state = jax.eval_shape(fns.init, jax.random.key(0), sup, x)
+    closed = jax.make_jaxpr(fns.train_step)(params, opt_state, sup, x, y, mask)
+    flow = flow_program("bench_train_step", closed)
+    return {
+        "program": "train_step",
+        "operating_dtype": operating,
+        "bytes_by_dtype": flow.census["bytes"],
+        "flops_by_dtype": flow.census["flops"],
+        "casts": flow.census["casts"],
+        "sites": len(flow.sites),
+        "param_census": leaf_dtype_census(params),
+    }
+
+
 def _data_residency() -> dict:
     """The canonical point's data-residency story: window-free resident
     bytes vs materialized windows, and the dataset build time with and
@@ -1388,6 +1419,13 @@ def main() -> None:
         record["health"] = _health_rider()
     except Exception as e:  # the health story must not void the record
         print(f"bench: health rider failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        # precision-census evidence: the canonical step's dtype census at
+        # the headline operating point (see _precision_rider)
+        record["precision"] = _precision_rider()
+    except Exception as e:  # the precision story must not void the record
+        print(f"bench: precision rider failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     if probe_err is not None:
         record["platform"] = "cpu-fallback"

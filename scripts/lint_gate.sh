@@ -16,6 +16,8 @@
 #    "continual": {"exit": N, "promotions": N|null, "rejections": N|null,
 #    "nonfinite": N|null},
 #    "spmd": {"exit": N, "programs": N|null, "collectives": N|null,
+#    "findings": N|null},
+#    "precision": {"exit": N, "programs": N|null, "sites": N|null,
 #    "findings": N|null}}
 #
 # The "concurrency" section is explicit evidence the static concurrency
@@ -171,12 +173,32 @@ EOF
 spmd_exit=$?
 printf '%s\n' "$spmd_json" >&2
 
+# Precision dataflow evidence: the dtype walk must have covered every
+# registered contract program (zero programs walked means the registry
+# silently emptied) and judged every classified site against the
+# declared PrecisionPolicy with zero findings.
+precision_json=$("$PY" - <<'EOF' 2>>/dev/stderr
+import json
+
+from stmgcn_tpu.utils.platform import force_host_platform
+
+force_host_platform("cpu", n_devices=8)
+
+from stmgcn_tpu.analysis.precision_check import precision_summary
+
+print(json.dumps(precision_summary()))
+EOF
+)
+precision_exit=$?
+printf '%s\n' "$precision_json" >&2
+
 LINT_JSON="$lint_json" LINT_EXIT="$lint_exit" \
 CONC_JSON="$conc_json" CONC_EXIT="$conc_exit" \
 RUFF_AVAILABLE="$ruff_available" RUFF_EXIT="$ruff_exit" \
 OBS_JSON="$obs_json" OBS_EXIT="$obs_exit" \
 CONTINUAL_JSON="$continual_json" CONTINUAL_EXIT="$continual_exit" \
 SPMD_JSON="$spmd_json" SPMD_EXIT="$spmd_exit" \
+PRECISION_JSON="$precision_json" PRECISION_EXIT="$precision_exit" \
 "$PY" - <<'EOF'
 import json
 import os
@@ -210,6 +232,11 @@ try:
 except ValueError:
     spmd = {}
 spmd_exit = int(os.environ["SPMD_EXIT"])
+try:
+    precision = json.loads(os.environ["PRECISION_JSON"])
+except ValueError:
+    precision = {}
+precision_exit = int(os.environ["PRECISION_EXIT"])
 
 ok = lint_exit == 0 and report.get("errors") == 0
 # concurrency pass must have run over a real class model and come back
@@ -238,6 +265,12 @@ ok = ok and continual.get("nonfinite") == 0
 ok = ok and spmd_exit == 0
 ok = ok and (spmd.get("programs") or 0) > 0
 ok = ok and spmd.get("findings") == 0
+# precision dataflow pass: every registered contract program dtype-walked
+# (zero programs means the precision certification silently hollowed out)
+# with zero policy/accumulator/cast findings
+ok = ok and precision_exit == 0
+ok = ok and (precision.get("programs") or 0) > 0
+ok = ok and precision.get("findings") == 0
 print(json.dumps({
     "gate": "PASS" if ok else "FAIL",
     "lint": {
@@ -275,6 +308,12 @@ print(json.dumps({
         "programs": spmd.get("programs"),
         "collectives": spmd.get("collectives"),
         "findings": spmd.get("findings"),
+    },
+    "precision": {
+        "exit": precision_exit,
+        "programs": precision.get("programs"),
+        "sites": precision.get("sites"),
+        "findings": precision.get("findings"),
     },
 }))
 sys.exit(0 if ok else 1)

@@ -54,7 +54,18 @@ by tier-1 (``tests/test_analysis.py``):
   fp32-forward Mosaic OOM from source alone, and tiled-support plan
   math for every preset that turns on ``model.tiled`` (knob ranges,
   mode conflicts, tile-grid node-padding waste vs the budget, kernel
-  VMEM at the configured tile — :mod:`.tiling_check`).
+  VMEM at the configured tile — :mod:`.tiling_check`). The precision
+  dataflow pass (:mod:`.dtype_flow` + :mod:`.precision_check`) rides
+  the same traces: an abstract dtype interpreter tags every eqn of
+  every registered contract program with its dtype and provenance
+  chain, classifies sites by role (dot-general operands/accumulators,
+  sum reductions, scan/while carries, psum, normalization stats,
+  casts), and judges them against the preset's declarative
+  ``PrecisionPolicy`` — three error rules (``precision-policy``,
+  ``accum-dtype``, ``implicit-cast``) plus a per-program dtype census
+  pinned by ``PRECISION_BASELINES`` (``--rebaseline``), so a bf16
+  migration lands pre-certified by lint instead of discovered by loss
+  curves.
 
 Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 ``# stmgcn: ignore``) on the offending line.
@@ -68,7 +79,12 @@ from stmgcn_tpu.analysis.health_check import check_health_overhead
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
 from stmgcn_tpu.analysis.lint import lint_package, lint_paths, lint_source
 from stmgcn_tpu.analysis.obs_check import check_obs_overhead
+from stmgcn_tpu.analysis.dtype_flow import flow_program, program_flows
 from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
+from stmgcn_tpu.analysis.precision_check import (
+    check_precision,
+    precision_summary,
+)
 from stmgcn_tpu.analysis.program_db import ProgramDB
 from stmgcn_tpu.analysis.report import (
     Finding,
@@ -103,6 +119,7 @@ __all__ = [
     "check_obs_overhead",
     "check_pallas_kernels",
     "check_partition_specs",
+    "check_precision",
     "check_resident_memory",
     "check_serving_buckets",
     "check_serving_slo",
@@ -110,9 +127,12 @@ __all__ = [
     "check_step_contracts",
     "check_tile_plan",
     "declared_manifests",
+    "flow_program",
     "lint_package",
     "lint_paths",
     "lint_source",
+    "precision_summary",
+    "program_flows",
     "render_json",
     "render_sarif",
     "render_text",

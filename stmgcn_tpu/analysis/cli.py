@@ -64,8 +64,11 @@ def build_lint_parser() -> argparse.ArgumentParser:
                         "in stmgcn_tpu/analysis/jaxpr_check.py, and measure "
                         "the spmd probe programs' collective bytes-on-wire "
                         "and rewrite WIRE_BUDGETS in analysis/spmd_check.py, "
+                        "and measure the per-program dtype census and rewrite "
+                        "PRECISION_BASELINES in analysis/precision_check.py, "
                         "then exit — the deliberate-rebaseline command for "
-                        "features that move a step's op count or wire volume")
+                        "features that move a step's op count, wire volume, "
+                        "or precision census")
     return p
 
 
@@ -84,6 +87,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         import json
 
         from stmgcn_tpu.analysis.jaxpr_check import rebaseline
+        from stmgcn_tpu.analysis.precision_check import rebaseline_precision
         from stmgcn_tpu.analysis.spmd_check import rebaseline_wire
         from stmgcn_tpu.utils.platform import force_host_platform
 
@@ -92,8 +96,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         force_host_platform("cpu", n_devices=8)
         result = rebaseline(preset_name=args.preset)
         wire = rebaseline_wire()
+        precision = rebaseline_precision(preset_name=args.preset)
         if args.format == "json":
-            print(json.dumps({**result, "wire": wire}))
+            print(json.dumps({**result, "wire": wire, "precision": precision}))
         else:
             for name, count in result["counts"].items():
                 print(
@@ -107,6 +112,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"budget {wire['budgets'][name]}"
                 )
             print(f"rewrote WIRE_BUDGETS in {wire['path']}")
+            for name, census in precision["census"].items():
+                floats = sorted(census["bytes"])
+                print(
+                    f"{name}: dtype census {floats}, "
+                    f"{census['casts']} cast(s)"
+                )
+            print(f"rewrote PRECISION_BASELINES in {precision['path']}")
         return 0
 
     from stmgcn_tpu.analysis.lint import lint_package, lint_paths
@@ -134,6 +146,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
         from stmgcn_tpu.analysis.obs_check import check_obs_overhead
         from stmgcn_tpu.analysis.pallas_check import check_pallas_kernels
+        from stmgcn_tpu.analysis.precision_check import check_precision
         from stmgcn_tpu.analysis.resident_check import check_resident_memory
         from stmgcn_tpu.analysis.serving_check import (
             check_serving_buckets,
@@ -164,6 +177,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_pallas_kernels())
         findings.extend(check_step_contracts(args.preset))
         findings.extend(check_spmd_contracts())
+        # precision pass reuses the step-contract traces (one walk per
+        # program via the shared program_flows cache)
+        findings.extend(check_precision(args.preset))
     elif not args.paths:
         from stmgcn_tpu.analysis.sharding_check import check_partition_specs
 

@@ -1,0 +1,447 @@
+"""Abstract dtype-dataflow interpretation over traced step jaxprs.
+
+The contract pass has walked every registered step program since PR 1,
+but dtype-blind: the only precision rule was a pointwise float64 scan.
+This module gives the walk dtype eyes — ONE recursive pass per program
+that tags every eqn with
+
+- a **dtype lattice value** (operand dtypes in, output dtypes out),
+- a **provenance chain** (which program input / constant / cast site the
+  value's dtype descends from, cast and promotion steps appended), and
+- a **site role** from the precision taxonomy (dot-general operand,
+  dot-general accumulator, accumulating reduction, order statistic,
+  scan/while carry, cross-device psum, normalization stat, cast),
+
+plus a per-program **dtype census** (bytes and FLOPs by dtype, count of
+dtype-changing casts) and the structured float64 events
+:mod:`.jaxpr_check`'s ``fp64-promotion`` rule formats — so the fp64 scan
+and the precision pass share this one walk instead of walking twice.
+
+:mod:`.precision_check` judges the resulting :class:`ProgramFlow`
+objects against the declarative :class:`stmgcn_tpu.config
+.PrecisionPolicy`; this module only observes, it never emits findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DtypeSite",
+    "ProgramFlow",
+    "flow_program",
+    "program_flows",
+    "sub_jaxprs",
+    "walk_eqns",
+]
+
+#: float dtype names the policy layer reasons about (np.dtype(...).name
+#: for every floating dtype JAX can put in a step program; bfloat16's
+#: numpy kind is 'V', so kind-based detection would miss it)
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+#: accumulating reductions: the output is a sum of many addends, so a
+#: sub-f32 dtype loses low-order bits on every add (the classic bf16
+#: accumulation hazard)
+_ACCUM_PRIMS = frozenset(
+    {"reduce_sum", "reduce_prod", "cumsum", "cumprod", "add_any",
+     "reduce_window_sum", "cumlogsumexp"}
+)
+
+#: order statistics: max/min select, they never accumulate — safe at the
+#: compute dtype
+_ORDER_PRIMS = frozenset(
+    {"reduce_max", "reduce_min", "reduce_and", "reduce_or", "cummax",
+     "cummin", "argmax", "argmin", "reduce_window_max",
+     "reduce_window_min"}
+)
+
+#: cross-device sum reductions (gradient syncs): the SPMD twin of
+#: reduce_sum, same accumulation hazard over the wire
+_PSUM_PRIMS = frozenset({"psum", "psum2"})
+
+#: normalization stats (variance -> sqrt / rsqrt chains: global_norm,
+#: Welford moments, layer-norm denominators) — stat precision gates the
+#: whole normalized tensor
+_NORM_PRIMS = frozenset({"sqrt", "rsqrt"})
+
+
+def sub_jaxprs(params: dict):
+    """Yield every ClosedJaxpr/Jaxpr value inside an eqn's params."""
+    try:  # the forward-portable home (jax >= 0.4.33; jax.core goes in 0.6)
+        from jax.extend.core import ClosedJaxpr, Jaxpr
+    except ImportError:
+        from jax.core import ClosedJaxpr, Jaxpr
+
+    for v in params.values():
+        if isinstance(v, (ClosedJaxpr, Jaxpr)):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                if isinstance(item, (ClosedJaxpr, Jaxpr)):
+                    yield item
+
+
+def walk_eqns(jaxpr):
+    """Yield every eqn, recursing into call/control-flow sub-jaxprs."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in inner.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn.params):
+            yield from walk_eqns(sub)
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypeSite:
+    """One role-classified dtype site in a walked program.
+
+    ``eqn_index`` is the eqn's position in the recursive walk order
+    (:func:`walk_eqns` — stable for a given trace, so a finding can name
+    the exact eqn). ``provenance`` is the dtype's descent chain, seed
+    first: ``input:<label>[i]`` / ``const:<dtype>`` / ``lit:<dtype>``,
+    with ``cast:<src>-><dst>`` and ``promote:<prim>-><dtype>`` steps
+    appended as the value flows.
+    """
+
+    program: str
+    eqn_index: int
+    primitive: str
+    role: str
+    dtype: str
+    operand_dtypes: Tuple[str, ...]
+    out_dtypes: Tuple[str, ...]
+    provenance: Tuple[str, ...]
+    detail: str = ""
+
+    def describe(self) -> str:
+        """The finding-message fragment naming this site exactly."""
+        d = f" {self.detail}" if self.detail else ""
+        return (
+            f"{self.program}: eqn #{self.eqn_index} ({self.primitive}){d} "
+            f"[{self.role}] dtype {self.dtype}, provenance "
+            f"{' -> '.join(self.provenance) or '?'}"
+        )
+
+
+@dataclasses.dataclass
+class ProgramFlow:
+    """Everything one dtype walk learned about one traced program."""
+
+    name: str
+    sites: List[DtypeSite]
+    #: {"bytes": {dtype: n}, "flops": {dtype: n}, "casts": n, "eqns": n}
+    census: dict
+    #: ordered float64 events for jaxpr_check's fp64-promotion messages:
+    #: {"kind": "convert", "source": str} / {"kind": "out", "primitive": str}
+    fp64_events: List[dict]
+    eqn_count: int
+    in_labels: Tuple[str, ...]
+    out_labels: Tuple[str, ...]
+    in_dtypes: Tuple[Optional[str], ...]
+    out_dtypes: Tuple[Optional[str], ...]
+
+
+def _dtype_name(aval) -> Optional[str]:
+    dt = getattr(aval, "dtype", None)
+    if dt is None:
+        return None
+    try:
+        return np.dtype(dt).name
+    except TypeError:
+        return str(dt)
+
+
+def _var_dtype(var) -> Optional[str]:
+    return _dtype_name(getattr(var, "aval", None))
+
+
+def _is_float(name: Optional[str]) -> bool:
+    return name in FLOAT_DTYPES
+
+
+def _nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dt = getattr(aval, "dtype", None)
+    if shape is None or dt is None:
+        return 0
+    try:
+        return int(math.prod(shape)) * np.dtype(dt).itemsize
+    except (TypeError, ValueError):
+        return 0
+
+
+def _dot_general_flops(eqn) -> int:
+    """2 x output-size x contracted extent for one dot_general eqn."""
+    try:
+        (lhs_c, _), _ = eqn.params["dimension_numbers"]
+        lhs_shape = eqn.invars[0].aval.shape
+        k = int(math.prod(lhs_shape[d] for d in lhs_c)) or 1
+        out_size = int(math.prod(eqn.outvars[0].aval.shape)) or 1
+        return 2 * out_size * k
+    except (AttributeError, KeyError, TypeError, IndexError):
+        return 0
+
+
+def flow_program(
+    name: str,
+    closed,
+    in_labels: Optional[Sequence[str]] = None,
+    out_labels: Optional[Sequence[str]] = None,
+) -> ProgramFlow:
+    """One recursive dtype walk over a ClosedJaxpr.
+
+    ``in_labels`` (one per flattened invar, e.g. from
+    :data:`stmgcn_tpu.train.step.PRECISION_ROLES` expanded by the trace
+    registry) seed the provenance chains; without them invars are
+    labeled ``arg``. ``out_labels`` are recorded for the boundary checks
+    (master-param / loss dtype) but do not affect the walk.
+    """
+    inner = closed.jaxpr
+    n_in = len(inner.invars)
+    labels = list(in_labels) if in_labels is not None else ["arg"] * n_in
+    if len(labels) != n_in:
+        raise ValueError(
+            f"{name}: {len(labels)} in_labels for {n_in} invars"
+        )
+
+    sites: List[DtypeSite] = []
+    fp64_events: List[dict] = []
+    bytes_by: Dict[str, int] = {}
+    flops_by: Dict[str, int] = {}
+    counters = {"eqn": 0, "casts": 0}
+    f64 = np.dtype(np.float64)
+
+    env: Dict[object, Tuple[str, ...]] = {}
+    group_counts: Dict[str, int] = {}
+    for var, label in zip(inner.invars, labels):
+        i = group_counts.get(label, 0)
+        group_counts[label] = i + 1
+        env[var] = (f"input:{label}[{i}]",)
+
+    def prov(var, local_env) -> Tuple[str, ...]:
+        try:
+            got = local_env.get(var)
+        except TypeError:  # Literals are unhashable — they ARE their value
+            got = None
+        if got is not None:
+            return got
+        return (f"lit:{_var_dtype(var) or '?'}",)
+
+    def seed_consts(jaxpr, local_env) -> None:
+        for cv in jaxpr.constvars:
+            local_env[cv] = (f"const:{_var_dtype(cv) or '?'}",)
+
+    def visit(jaxpr, local_env) -> None:
+        seed_consts(jaxpr, local_env)
+        for eqn in jaxpr.eqns:
+            idx = counters["eqn"]
+            counters["eqn"] += 1
+            prim = eqn.primitive.name
+            in_dts = tuple(_var_dtype(v) for v in eqn.invars)
+            out_dts = tuple(_var_dtype(v) for v in eqn.outvars)
+
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                dn = _dtype_name(aval)
+                if dn is not None:
+                    bytes_by[dn] = bytes_by.get(dn, 0) + _nbytes(aval)
+            if prim == "dot_general":
+                flops = _dot_general_flops(eqn)
+                dn = out_dts[0] if out_dts else None
+                if flops and dn:
+                    flops_by[dn] = flops_by.get(dn, 0) + flops
+
+            # the fp64 events, in the exact (convert-then-outvar) order
+            # jaxpr_check's original two-branch scan emitted them
+            if (
+                prim == "convert_element_type"
+                and np.dtype(eqn.params.get("new_dtype", np.float32)) == f64
+            ):
+                fp64_events.append({
+                    "kind": "convert",
+                    "source": str(eqn.source_info.traceback),
+                })
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is not None and getattr(aval, "dtype", None) == f64:
+                    fp64_events.append({"kind": "out", "primitive": prim})
+
+            # -- provenance + role sites ------------------------------
+            if prim == "convert_element_type":
+                src, dst = in_dts[0], out_dts[0]
+                chain = prov(eqn.invars[0], local_env)
+                if src != dst:
+                    chain = chain + (f"cast:{src}->{dst}",)
+                    counters["casts"] += 1
+                    sites.append(DtypeSite(
+                        program=name, eqn_index=idx, primitive=prim,
+                        role="cast", dtype=dst or "?",
+                        operand_dtypes=(src or "?",), out_dtypes=out_dts,
+                        provenance=chain,
+                    ))
+                for var in eqn.outvars:
+                    local_env[var] = chain
+            else:
+                in_chains = [prov(v, local_env) for v in eqn.invars]
+                for var in eqn.outvars:
+                    dn = _var_dtype(var)
+                    chain: Tuple[str, ...] = ()
+                    for v, c in zip(eqn.invars, in_chains):
+                        if _var_dtype(v) == dn:
+                            chain = c
+                            break
+                    if not chain:
+                        chain = in_chains[0] if in_chains else ()
+                        if _is_float(dn):
+                            chain = chain + (f"promote:{prim}->{dn}",)
+                    local_env[var] = chain
+
+                role_dt = out_dts[0] if out_dts else None
+                if prim == "dot_general":
+                    if any(_is_float(d) for d in in_dts):
+                        sites.append(DtypeSite(
+                            program=name, eqn_index=idx, primitive=prim,
+                            role="dot_general", dtype=in_dts[0] or "?",
+                            operand_dtypes=in_dts, out_dtypes=out_dts,
+                            provenance=prov(eqn.invars[0], local_env),
+                        ))
+                        pref = eqn.params.get("preferred_element_type")
+                        acc = (
+                            np.dtype(pref).name if pref is not None
+                            else role_dt
+                        )
+                        sites.append(DtypeSite(
+                            program=name, eqn_index=idx, primitive=prim,
+                            role="dot_general_accum", dtype=acc or "?",
+                            operand_dtypes=in_dts, out_dtypes=out_dts,
+                            provenance=prov(eqn.invars[0], local_env),
+                            detail="accumulator",
+                        ))
+                elif prim in _ACCUM_PRIMS and _is_float(role_dt):
+                    sites.append(DtypeSite(
+                        program=name, eqn_index=idx, primitive=prim,
+                        role="reduce_sum", dtype=role_dt,
+                        operand_dtypes=in_dts, out_dtypes=out_dts,
+                        provenance=prov(eqn.invars[0], local_env),
+                    ))
+                elif prim in _ORDER_PRIMS and _is_float(role_dt):
+                    sites.append(DtypeSite(
+                        program=name, eqn_index=idx, primitive=prim,
+                        role="reduce_order", dtype=role_dt,
+                        operand_dtypes=in_dts, out_dtypes=out_dts,
+                        provenance=prov(eqn.invars[0], local_env),
+                    ))
+                elif prim in _PSUM_PRIMS:
+                    for j, (v, d) in enumerate(zip(eqn.invars, in_dts)):
+                        if _is_float(d):
+                            sites.append(DtypeSite(
+                                program=name, eqn_index=idx, primitive=prim,
+                                role="psum", dtype=d,
+                                operand_dtypes=in_dts, out_dtypes=out_dts,
+                                provenance=prov(v, local_env),
+                                detail=f"operand[{j}]",
+                            ))
+                elif prim in _NORM_PRIMS and _is_float(role_dt):
+                    sites.append(DtypeSite(
+                        program=name, eqn_index=idx, primitive=prim,
+                        role="normalization", dtype=role_dt,
+                        operand_dtypes=in_dts, out_dtypes=out_dts,
+                        provenance=prov(eqn.invars[0], local_env),
+                    ))
+                elif prim == "scan":
+                    nc = eqn.params.get("num_consts", 0)
+                    nk = eqn.params.get("num_carry", 0)
+                    carries = eqn.invars[nc:nc + nk]
+                    for j, v in enumerate(carries):
+                        d = _var_dtype(v)
+                        if _is_float(d):
+                            sites.append(DtypeSite(
+                                program=name, eqn_index=idx, primitive=prim,
+                                role="scan_carry", dtype=d,
+                                operand_dtypes=in_dts, out_dtypes=out_dts,
+                                provenance=prov(v, local_env),
+                                detail=f"carry[{j}]",
+                            ))
+                elif prim == "while":
+                    nc = (eqn.params.get("cond_nconsts", 0)
+                          + eqn.params.get("body_nconsts", 0))
+                    for j, v in enumerate(eqn.invars[nc:]):
+                        d = _var_dtype(v)
+                        if _is_float(d):
+                            sites.append(DtypeSite(
+                                program=name, eqn_index=idx, primitive=prim,
+                                role="scan_carry", dtype=d,
+                                operand_dtypes=in_dts, out_dtypes=out_dts,
+                                provenance=prov(v, local_env),
+                                detail=f"while_carry[{j}]",
+                            ))
+
+            for sub in sub_jaxprs(eqn.params):
+                sub_inner = getattr(sub, "jaxpr", sub)
+                sub_env: Dict[object, Tuple[str, ...]] = {}
+                n_sub = len(sub_inner.invars)
+                if n_sub <= len(eqn.invars):
+                    # positional suffix alignment: scan/pjit bind all
+                    # their operands, cond drops the leading predicate,
+                    # while's body consts+carry trail the cond consts
+                    src_vars = list(eqn.invars)[len(eqn.invars) - n_sub:]
+                    for sv, ov in zip(sub_inner.invars, src_vars):
+                        sub_env[sv] = prov(ov, local_env)
+                else:
+                    for sv in sub_inner.invars:
+                        sub_env[sv] = (f"opaque:{prim}",)
+                visit(sub_inner, sub_env)
+
+    visit(inner, env)
+
+    n_out = len(inner.outvars)
+    outs = list(out_labels) if out_labels is not None else ["out"] * n_out
+    if len(outs) != n_out:
+        raise ValueError(f"{name}: {len(outs)} out_labels for {n_out} outvars")
+    return ProgramFlow(
+        name=name,
+        sites=sites,
+        census={
+            "bytes": dict(sorted(bytes_by.items())),
+            "flops": dict(sorted(flops_by.items())),
+            "casts": counters["casts"],
+            "eqns": counters["eqn"],
+        },
+        fp64_events=fp64_events,
+        eqn_count=counters["eqn"],
+        in_labels=tuple(labels),
+        out_labels=tuple(outs),
+        in_dtypes=tuple(_var_dtype(v) for v in inner.invars),
+        out_dtypes=tuple(_var_dtype(v) for v in inner.outvars),
+    )
+
+
+_FLOW_CACHE: Dict[str, Dict[str, ProgramFlow]] = {}
+
+
+def program_flows(preset_name: str = "smoke") -> Dict[str, ProgramFlow]:
+    """One :class:`ProgramFlow` per registered contract program.
+
+    Cached per preset and per process: the fp64-promotion scan
+    (:mod:`.jaxpr_check`), the precision rules
+    (:mod:`.precision_check`), and the lint-gate summary all consume
+    this one walk — tracing and walking happen once.
+    """
+    cached = _FLOW_CACHE.get(preset_name)
+    if cached is not None:
+        return cached
+    from stmgcn_tpu.analysis.jaxpr_check import _trace_step_programs
+
+    flows = {
+        name: flow_program(
+            name, rec["jaxpr"],
+            in_labels=rec["in_labels"], out_labels=rec["out_labels"],
+        )
+        for name, rec in _trace_step_programs(preset_name).items()
+    }
+    _FLOW_CACHE[preset_name] = flows
+    return flows

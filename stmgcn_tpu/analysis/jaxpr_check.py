@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from stmgcn_tpu.analysis.dtype_flow import sub_jaxprs, walk_eqns
 from stmgcn_tpu.analysis.report import Finding
 from stmgcn_tpu.analysis.rules import RULES
 
@@ -42,6 +43,11 @@ __all__ = [
     "rebaseline",
 ]
 
+# the walk helpers moved to dtype_flow (the shared engine); the old
+# private names stay importable for existing callers
+_sub_jaxprs = sub_jaxprs
+_walk_eqns = walk_eqns
+
 #: measured counts x ~2 headroom for legitimate feature growth (see the
 #: trailer comment) — the guard is against order-of-magnitude
 #: fusion/unroll regressions (an accidentally unrolled scan multiplies
@@ -51,35 +57,17 @@ __all__ = [
 PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_series_superstep_health": 1310, "train_fleet_superstep": 970, "serve_fleet_bucket": 270, "train_step_checked": 3290}
 
 
-def _sub_jaxprs(params: dict):
-    try:  # the forward-portable home (jax >= 0.4.33; jax.core goes in 0.6)
-        from jax.extend.core import ClosedJaxpr, Jaxpr
-    except ImportError:
-        from jax.core import ClosedJaxpr, Jaxpr
-
-    for v in params.values():
-        if isinstance(v, (ClosedJaxpr, Jaxpr)):
-            yield v
-        elif isinstance(v, (tuple, list)):
-            for item in v:
-                if isinstance(item, (ClosedJaxpr, Jaxpr)):
-                    yield item
-
-
-def _walk_eqns(jaxpr):
-    """Yield every eqn, recursing into call/control-flow sub-jaxprs."""
-    inner = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
-    for eqn in inner.eqns:
-        yield eqn
-        for sub in _sub_jaxprs(eqn.params):
-            yield from _walk_eqns(sub)
-
-
 def count_primitives(jaxpr) -> int:
-    return sum(1 for _ in _walk_eqns(jaxpr))
+    return sum(1 for _ in walk_eqns(jaxpr))
 
 
-def _check_one(name: str, closed, n_strong_inputs: bool, budget: Optional[int]):
+def _check_one(
+    name: str,
+    closed,
+    n_strong_inputs: bool,
+    budget: Optional[int],
+    fp64_events: Optional[list] = None,
+):
     findings: List[Finding] = []
     path = f"<contract:{name}>"
 
@@ -89,24 +77,27 @@ def _check_one(name: str, closed, n_strong_inputs: bool, budget: Optional[int]):
                     severity=RULES[rule].severity)
         )
 
-    f64 = np.dtype(np.float64)
-    for eqn in _walk_eqns(closed):
-        if (
-            eqn.primitive.name == "convert_element_type"
-            and np.dtype(eqn.params.get("new_dtype", np.float32)) == f64
-        ):
+    # fp64 detection is one job of the shared dtype walk
+    # (dtype_flow.flow_program); the events come pre-ordered exactly as
+    # the old two-branch eqn scan emitted them, so messages are
+    # byte-identical whether the caller hands in a cached flow or we
+    # walk here
+    if fp64_events is None:
+        from stmgcn_tpu.analysis.dtype_flow import flow_program
+
+        fp64_events = flow_program(name, closed).fp64_events
+    for ev in fp64_events:
+        if ev["kind"] == "convert":
             emit(
                 "fp64-promotion",
                 f"{name}: convert_element_type to float64 "
-                f"(source: {eqn.source_info.traceback})"[:500],
+                f"(source: {ev['source']})"[:500],
             )
-        for var in eqn.outvars:
-            aval = getattr(var, "aval", None)
-            if aval is not None and getattr(aval, "dtype", None) == f64:
-                emit(
-                    "fp64-promotion",
-                    f"{name}: {eqn.primitive.name} produces a float64 value",
-                )
+        else:
+            emit(
+                "fp64-promotion",
+                f"{name}: {ev['primitive']} produces a float64 value",
+            )
 
     if n_strong_inputs:
         for i, aval in enumerate(closed.out_avals):
@@ -130,6 +121,39 @@ def _check_one(name: str, closed, n_strong_inputs: bool, budget: Optional[int]):
     return findings
 
 
+#: per-preset trace cache: tracing is the expensive half of the
+#: contract pass, and three consumers (the contract checks, the dtype
+#: flows, the precision summary) now share one trace per process
+_TRACE_CACHE: Dict[str, Dict[str, dict]] = {}
+
+
+def _expand_roles(roles, sizes: Dict[str, int], total: int, name: str):
+    """Expand per-argument precision roles to per-leaf labels.
+
+    ``param``/``opt_state`` expand to their pytree leaf counts, a
+    trailing-``*`` role absorbs whatever leaf count remains (checkify
+    error payloads, health stats), everything else is one leaf.
+    """
+    wild = [r for r in roles if r.endswith("*")]
+    if len(wild) > 1:
+        raise ValueError(f"{name}: more than one wildcard role in {roles}")
+    fixed = sum(
+        sizes.get(r, 1) for r in roles if not r.endswith("*")
+    )
+    labels: List[str] = []
+    for r in roles:
+        if r.endswith("*"):
+            labels.extend([r[:-1]] * (total - fixed))
+        else:
+            labels.extend([r] * sizes.get(r, 1))
+    if len(labels) != total:
+        raise ValueError(
+            f"{name}: precision roles {roles} expand to {len(labels)} "
+            f"labels for {total} leaves"
+        )
+    return tuple(labels)
+
+
 def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     """Abstractly trace every checked step program of a preset.
 
@@ -140,6 +164,24 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     primitive count is S-invariant (the S steps are one scan sub-jaxpr),
     so any fixed S>1 guards the fused program.
     """
+    return {
+        name: rec["jaxpr"]
+        for name, rec in _trace_step_programs(preset_name).items()
+    }
+
+
+def _trace_step_programs(preset_name: str = "smoke") -> Dict[str, dict]:
+    """The traced registry with per-leaf precision labels attached.
+
+    Returns ``{name: {"jaxpr": ClosedJaxpr, "in_labels": tuple,
+    "out_labels": tuple}}`` — the labels expand
+    :data:`stmgcn_tpu.train.step.PRECISION_ROLES` over the actual
+    flattened arities, seeding the dtype-flow pass's provenance chains
+    and its master-param/loss boundary checks. Cached per preset.
+    """
+    cached = _TRACE_CACHE.get(preset_name)
+    if cached is not None:
+        return cached
     import jax
     import jax.numpy as jnp
 
@@ -211,7 +253,7 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
     hist_bucket = jax.ShapeDtypeStruct((bucket, t, n, c), f32)
 
     params, opt_state = jax.eval_shape(fns.init, jax.random.PRNGKey(0), sup, x)
-    return {
+    programs = {
         "serve_bucket": jax.make_jaxpr(serve_bucket_fn(model))(
             params, sup, hist_bucket
         ),
@@ -258,16 +300,44 @@ def _trace_step_jaxprs(preset_name: str = "smoke") -> Dict[str, object]:
         )(params, opt_state, sup, x, y, mask),
     }
 
+    from stmgcn_tpu.train.step import PRECISION_ROLES
+
+    sizes = {
+        "param": len(jax.tree.leaves(params)),
+        "opt_state": len(jax.tree.leaves(opt_state)),
+    }
+    records: Dict[str, dict] = {}
+    for name, closed in programs.items():
+        in_roles, out_roles = PRECISION_ROLES[name]
+        records[name] = {
+            "jaxpr": closed,
+            "in_labels": _expand_roles(
+                in_roles, sizes, len(closed.jaxpr.invars), name
+            ),
+            "out_labels": _expand_roles(
+                out_roles, sizes, len(closed.jaxpr.outvars), name
+            ),
+        }
+    _TRACE_CACHE[preset_name] = records
+    return records
+
 
 def check_step_contracts(preset_name: str = "smoke") -> List[Finding]:
     """Trace the preset's step programs abstractly and check contracts."""
+    from stmgcn_tpu.analysis.dtype_flow import program_flows
+
     findings: List[Finding] = []
+    flows = program_flows(preset_name)
     for name, closed in _trace_step_jaxprs(preset_name).items():
         # checkify's error-payload outputs are weak-typed by construction
         # and never feed back into the step inputs, so the weak-type
         # contract does not apply to the checked program
         strong = name != "train_step_checked"
-        findings += _check_one(name, closed, strong, PRIMITIVE_BUDGETS.get(name))
+        flow = flows.get(name)
+        findings += _check_one(
+            name, closed, strong, PRIMITIVE_BUDGETS.get(name),
+            fp64_events=flow.fp64_events if flow is not None else None,
+        )
     return findings
 
 

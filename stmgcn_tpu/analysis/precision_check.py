@@ -1,0 +1,295 @@
+"""Pass 2h: precision dataflow contracts over every traced program.
+
+Judges the role-classified dtype sites :mod:`.dtype_flow` extracts from
+every registered contract program against the declarative
+:class:`stmgcn_tpu.config.PrecisionPolicy` — three error rules on the
+standard lint machinery:
+
+- **precision-policy** — a site's compute dtype outside its role's
+  allowance, a self-contradictory policy, a registered program the walk
+  missed (coverage is checked, not assumed), a master-param or loss
+  boundary leaf off the declared dtype, or a census drift from
+  :data:`PRECISION_BASELINES`.
+- **accum-dtype** — any mandatory-f32 reduction role (sum reductions,
+  scan/while carries, psum operands, dot-general accumulators) holding
+  a floating dtype narrower than f32; the finding names the exact eqn
+  and carry leaf with its full provenance chain.
+- **implicit-cast** — a float->float dtype-changing cast the policy's
+  whitelist never declared (casts to f64 stay with fp64-promotion).
+
+The per-program **dtype census** (bytes and FLOPs by dtype, count of
+dtype-changing casts) is persisted as the single-line
+:data:`PRECISION_BASELINES` literal by ``stmgcn lint --rebaseline``
+(:func:`rebaseline_precision`) — the future bf16 migration lands as a
+measured census diff plus a deliberate rebaseline, never silent drift.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from stmgcn_tpu.analysis.dtype_flow import (
+    FLOAT_DTYPES,
+    DtypeSite,
+    ProgramFlow,
+    program_flows,
+)
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = [
+    "PRECISION_BASELINES",
+    "check_flow",
+    "check_precision",
+    "measured_census",
+    "precision_summary",
+    "rebaseline_precision",
+]
+
+#: measured per-program dtype census (bytes/FLOPs by dtype, count of
+#: dtype-changing casts) — the precision twin of PRIMITIVE_BUDGETS. The
+#: float-dtype *set* is gated exactly (a new floating dtype in any
+#: program is drift) and the cast count at ~2x headroom; the byte/FLOP
+#: values are provenance for census diffs, not gates. Keep this a
+#: single-line literal: ``stmgcn lint --rebaseline`` rewrites it in
+#: place from the measured census (:func:`rebaseline_precision`).
+PRECISION_BASELINES = {'eval_step': {'bytes': {'bool': 3, 'float32': 56788692, 'int32': 48}, 'flops': {'float32': 121699200}, 'casts': 0, 'eqns': 94}, 'serve_bucket': {'bytes': {'bool': 3, 'float32': 28369024, 'int32': 48}, 'flops': {'float32': 60849600}, 'casts': 0, 'eqns': 85}, 'serve_fleet_bucket': {'bytes': {'bool': 1731, 'float32': 41197376, 'int32': 1552}, 'flops': {'float32': 60849600}, 'casts': 2, 'eqns': 133}, 'train_fleet_superstep': {'bytes': {'bool': 118890, 'float32': 146578200, 'int32': 5116}, 'flops': {'float32': 283977600}, 'casts': 4, 'eqns': 483}, 'train_series_superstep': {'bytes': {'bool': 118788, 'float32': 146061284, 'int32': 4700}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 455}, 'train_series_superstep_health': {'bytes': {'bool': 133988, 'float32': 146183392, 'int32': 35252}, 'flops': {'float32': 283977600}, 'casts': 14, 'eqns': 655}, 'train_step': {'bytes': {'bool': 118564, 'float32': 145816468, 'int32': 68}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 430}, 'train_step_checked': {'bytes': {'bool': 11302964, 'float32': 145725276, 'int32': 1296}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 1641}, 'train_superstep': {'bytes': {'bool': 118628, 'float32': 146061284, 'int32': 1096}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 445}}
+
+_ITEMSIZE = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8}
+_CAST_HEADROOM = 2.0
+
+
+def _emit(
+    findings: List[Finding], rule: str, name: str, message: str
+) -> None:
+    findings.append(
+        Finding(
+            rule=rule,
+            path=f"<contract:precision:{name}>",
+            line=0,
+            message=message,
+            severity=RULES[rule].severity,
+        )
+    )
+
+
+def _site_findings(
+    flow: ProgramFlow, site: DtypeSite, policy
+) -> List[Finding]:
+    findings: List[Finding] = []
+    if site.role == "cast":
+        src = site.operand_dtypes[0] if site.operand_dtypes else "?"
+        dst = site.dtype
+        if (
+            src in FLOAT_DTYPES
+            and dst in FLOAT_DTYPES
+            and src != dst
+            and dst != "float64"  # fp64-promotion owns promotions to f64
+            and (src, dst) not in policy.cast_whitelist
+        ):
+            _emit(
+                findings, "implicit-cast", flow.name,
+                f"{site.describe()}: cast {src}->{dst} is not in "
+                f"PrecisionPolicy.cast_whitelist "
+                f"{tuple(policy.cast_whitelist)} — a silent "
+                f"{'up' if _ITEMSIZE[dst] > _ITEMSIZE[src] else 'down'}cast "
+                "the migration plan never audited",
+            )
+        return findings
+    if site.role in policy.reduction_f32_roles:
+        # accumulation roles are owned by accum-dtype (one finding per
+        # hazard, not one per rule)
+        if site.dtype in FLOAT_DTYPES and _ITEMSIZE[site.dtype] < 4:
+            _emit(
+                findings, "accum-dtype", flow.name,
+                f"{site.describe()}: reduction accumulator narrower than "
+                f"float32 — role {site.role!r} is in "
+                "PrecisionPolicy.reduction_f32_roles (mandatory f32); "
+                "low-order bits are lost on every add",
+            )
+        return findings
+    allowed = policy.allowed(site.role)
+    if allowed is None:
+        return findings
+    checked = (
+        [d for d in site.operand_dtypes if d in FLOAT_DTYPES]
+        if site.role == "dot_general"
+        else ([site.dtype] if site.dtype in FLOAT_DTYPES else [])
+    )
+    bad = sorted({d for d in checked if d not in allowed})
+    if bad:
+        _emit(
+            findings, "precision-policy", flow.name,
+            f"{site.describe()}: dtype(s) {bad} outside "
+            f"PrecisionPolicy.role_dtypes[{site.role!r}] = {allowed}",
+        )
+    return findings
+
+
+def _boundary_findings(flow: ProgramFlow, policy) -> List[Finding]:
+    """Master-param / optimizer-state / loss dtype at program edges."""
+    findings: List[Finding] = []
+    master = policy.master_param_dtype
+    loss_allowed = policy.allowed("loss") or (master,)
+    for end, labels, dtypes in (
+        ("input", flow.in_labels, flow.in_dtypes),
+        ("output", flow.out_labels, flow.out_dtypes),
+    ):
+        seen: Dict[str, int] = {}
+        for label, dt in zip(labels, dtypes):
+            i = seen.get(label, 0)
+            seen[label] = i + 1
+            if dt not in FLOAT_DTYPES:
+                continue
+            if label in ("param", "opt_state") and dt != master:
+                _emit(
+                    findings, "precision-policy", flow.name,
+                    f"{flow.name}: {end} leaf {label}[{i}] has dtype "
+                    f"{dt}, but PrecisionPolicy.master_param_dtype is "
+                    f"{master!r} — master state must stay wide; cast for "
+                    "compute instead",
+                )
+            elif label == "loss" and dt not in loss_allowed:
+                _emit(
+                    findings, "precision-policy", flow.name,
+                    f"{flow.name}: {end} leaf loss[{i}] has dtype {dt} "
+                    f"outside PrecisionPolicy.role_dtypes['loss'] = "
+                    f"{loss_allowed}",
+                )
+    return findings
+
+
+def check_flow(flow: ProgramFlow, policy) -> List[Finding]:
+    """All three precision rules over one walked program."""
+    findings: List[Finding] = []
+    for site in flow.sites:
+        findings.extend(_site_findings(flow, site, policy))
+    findings.extend(_boundary_findings(flow, policy))
+    return findings
+
+
+def _census_findings(
+    name: str, census: dict, baseline: Optional[dict]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    if baseline is None:
+        _emit(
+            findings, "precision-policy", name,
+            f"{name}: no PRECISION_BASELINES entry — a new contract "
+            "program needs a deliberate census baseline; run "
+            "`stmgcn lint --rebaseline`",
+        )
+        return findings
+    measured_f = {d for d in census["bytes"] if d in FLOAT_DTYPES}
+    baseline_f = {d for d in baseline.get("bytes", {}) if d in FLOAT_DTYPES}
+    if measured_f != baseline_f:
+        _emit(
+            findings, "precision-policy", name,
+            f"{name}: floating dtype census drifted — measured "
+            f"{sorted(measured_f)} vs baseline {sorted(baseline_f)}; a "
+            "precision migration must land as `stmgcn lint "
+            "--rebaseline`, never as silent drift",
+        )
+    cast_budget = int(baseline.get("casts", 0) * _CAST_HEADROOM)
+    if census["casts"] > max(cast_budget, baseline.get("casts", 0)):
+        _emit(
+            findings, "precision-policy", name,
+            f"{name}: {census['casts']} dtype-changing casts > budget "
+            f"{cast_budget} (baseline {baseline.get('casts', 0)} x "
+            f"{_CAST_HEADROOM} headroom) — cast-boundary growth; "
+            "rebaseline deliberately if intended",
+        )
+    return findings
+
+
+def check_precision(
+    preset_name: str = "smoke",
+    policy=None,
+    flows: Optional[Dict[str, ProgramFlow]] = None,
+) -> List[Finding]:
+    """Walk every registered contract program and apply the policy.
+
+    ``policy``/``flows`` overrides exist for fixtures; the default is
+    the preset's declared :class:`~stmgcn_tpu.config.PrecisionPolicy`
+    over the cached :func:`~.dtype_flow.program_flows` registry.
+    """
+    from stmgcn_tpu.analysis.jaxpr_check import PRIMITIVE_BUDGETS
+    from stmgcn_tpu.config import preset
+
+    if policy is None:
+        policy = preset(preset_name).precision
+    findings: List[Finding] = []
+    for v in policy.violations():
+        _emit(findings, "precision-policy", "policy", f"PrecisionPolicy: {v}")
+    if flows is None:
+        flows = program_flows(preset_name)
+    # coverage is itself a contract: a registered program the dtype walk
+    # never saw is a hole in the certification, not a pass
+    for name in sorted(set(PRIMITIVE_BUDGETS) - set(flows)):
+        _emit(
+            findings, "precision-policy", name,
+            f"{name}: registered contract program was not walked by the "
+            "dtype-flow pass — precision coverage hole",
+        )
+    for name in sorted(flows):
+        flow = flows[name]
+        findings.extend(check_flow(flow, policy))
+        findings.extend(
+            _census_findings(name, flow.census, PRECISION_BASELINES.get(name))
+        )
+    return findings
+
+
+def measured_census(preset_name: str = "smoke") -> Dict[str, dict]:
+    """The current per-program dtype census (the rebaseline payload)."""
+    return {
+        name: flow.census
+        for name, flow in sorted(program_flows(preset_name).items())
+    }
+
+
+def precision_summary(preset_name: str = "smoke") -> dict:
+    """The lint-gate section: programs walked / sites classified /
+    unsuppressed findings (0 programs or any finding fails the gate)."""
+    flows = program_flows(preset_name)
+    findings = check_precision(preset_name, flows=flows)
+    return {
+        "programs": len(flows),
+        "sites": sum(len(f.sites) for f in flows.values()),
+        "findings": sum(1 for f in findings if not f.suppressed),
+    }
+
+
+def rebaseline_precision(
+    path: Optional[str] = None, preset_name: str = "smoke"
+) -> dict:
+    """Measure the dtype census and rewrite :data:`PRECISION_BASELINES`.
+
+    Same contract as the primitive/wire rebaselines: the measured
+    census is written verbatim into this module's single-line literal
+    (``path`` overrides the target for tests) and updated in-process so
+    subsequent checks see the new baseline. Cast headroom (~2x) is
+    applied at check time, not stored.
+
+    Returns ``{"census": ..., "path": ...}``.
+    """
+    census = measured_census(preset_name)
+    path = path or __file__
+    with open(path) as f:
+        src = f.read()
+    new_src, n_subs = re.subn(
+        r"PRECISION_BASELINES = \{.*\}",
+        "PRECISION_BASELINES = " + repr(census),
+        src,
+        count=1,
+    )
+    if n_subs != 1:
+        raise RuntimeError(
+            f"could not find the PRECISION_BASELINES literal in {path}"
+        )
+    with open(path, "w") as f:
+        f.write(new_src)
+    PRECISION_BASELINES.clear()
+    PRECISION_BASELINES.update(census)
+    return {"census": census, "path": path}

@@ -111,11 +111,22 @@ def render_sarif(findings: Iterable[Finding]) -> str:
 
     ordered = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
     rule_ids = sorted({f.rule for f in ordered})
+    # both descriptions must be non-empty for every rule: shortDescription
+    # is the registry summary (or the id for unregistered rules), and
+    # fullDescription falls back to the summary when a rule carries no
+    # long-form text — code-scanning uploads reject/blank-render empty
+    # description objects
     rules = [
         {
             "id": rid,
             "shortDescription": {
-                "text": RULES[rid].summary if rid in RULES else rid
+                "text": (RULES[rid].summary if rid in RULES else rid) or rid
+            },
+            "fullDescription": {
+                "text": (
+                    (RULES[rid].description or RULES[rid].summary)
+                    if rid in RULES else rid
+                ) or rid
             },
             "defaultConfiguration": {
                 "level": "error"

@@ -23,6 +23,10 @@ class Rule:
     id: str
     severity: str  # "error" | "warning"
     summary: str
+    #: long-form text for SARIF ``fullDescription`` (code-scanning UIs
+    #: show it on the rule page); empty falls back to ``summary`` so the
+    #: SARIF rules array never carries an empty description
+    description: str = ""
 
 
 _ALL_RULES = [
@@ -225,6 +229,76 @@ _ALL_RULES = [
         "raise), or the tiled SpMM's calibrated VMEM estimate at the "
         "configured tile size past the ~16 MiB/core budget — pure "
         "config math, detectable before any adjacency is built",
+    ),
+    # -- pass 2h: precision dataflow (dtype_flow + precision_check) -------
+    Rule(
+        "precision-policy",
+        "error",
+        "a dtype site's compute dtype is outside its role's PrecisionPolicy "
+        "allowance, the policy itself is self-contradictory, a registered "
+        "contract program escaped the dtype-flow walk, or the measured "
+        "dtype census drifted from PRECISION_BASELINES (rebaseline "
+        "deliberately with the feature that moved it)",
+        description=(
+            "The dtype-flow pass walks the jaxpr of every registered "
+            "contract program, classifies each eqn into the precision "
+            "role taxonomy (dot-general operand/accumulator, accumulating "
+            "reduction, order statistic, scan carry, psum, normalization "
+            "stat, cast, loss, optimizer update, master param), and "
+            "checks each site's dtype against the declarative "
+            "PrecisionPolicy in config.py. This rule fires when a site's "
+            "dtype falls outside its role's allowance (e.g. a bf16 "
+            "dot-general under a policy whose role_dtypes pins "
+            "dot_general to float32), when PrecisionPolicy.violations() "
+            "reports the policy self-contradictory, when a program in "
+            "the contract registry was not walked (a coverage hole is a "
+            "finding, not silence), or when the per-program dtype census "
+            "(float dtype set, cast count) drifts from the "
+            "PRECISION_BASELINES literal — the bf16 migration lands as a "
+            "deliberate `stmgcn lint --rebaseline`, never as silent "
+            "drift. Each finding names the eqn, role, provenance chain, "
+            "and the policy knob that bans it."
+        ),
+    ),
+    Rule(
+        "accum-dtype",
+        "error",
+        "a reduction accumulator — reduce_sum-family output, scan/while "
+        "carry leaf, psum operand, or dot-general accumulator — has a "
+        "floating dtype narrower than float32 (the classic bf16 "
+        "accumulation hazard: low-order bits lost on every add)",
+        description=(
+            "Accumulation sites sum many addends, so precision loss "
+            "compounds: a bf16 scan carry or reduce_sum silently diverges "
+            "training long after compilation succeeds. For every role in "
+            "PrecisionPolicy.reduction_f32_roles (by default reduce_sum, "
+            "scan_carry, psum, dot_general_accum) this rule fires on any "
+            "floating dtype with itemsize < 4 bytes, naming the exact "
+            "eqn (walk index and primitive), the carry leaf or operand "
+            "position, and the full dtype provenance chain back to the "
+            "program input, constant, or cast site that introduced the "
+            "narrow dtype. bf16 *compute* with f32 accumulation passes; "
+            "bf16 accumulation never does."
+        ),
+    ),
+    Rule(
+        "implicit-cast",
+        "error",
+        "a float->float dtype-changing convert_element_type the "
+        "PrecisionPolicy.cast_whitelist did not declare — a silent up- or "
+        "downcast the migration plan never audited",
+        description=(
+            "Every dtype-changing float cast in a traced program must "
+            "appear in PrecisionPolicy.cast_whitelist as a (src, dst) "
+            "pair (by default exactly the f32<->bf16 boundary). An "
+            "unwhitelisted cast is either an accidental promotion "
+            "(memory/bandwidth doubled behind the optimizer's back) or "
+            "an accidental truncation (precision lost where the policy "
+            "promised full width). Casts to float64 are excluded here — "
+            "the fp64-promotion rule owns those unconditionally. Each "
+            "finding names the eqn, the src->dst pair, and the "
+            "provenance chain of the value being cast."
+        ),
     ),
     # -- pass 2g: SPMD collective contracts (spmd_check) ------------------
     Rule(

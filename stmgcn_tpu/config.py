@@ -749,6 +749,177 @@ class ContinualConfig:
         return v
 
 
+#: float dtype names the precision policy can legislate over
+PRECISION_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+#: the site-role taxonomy the dtype-flow pass classifies eqns into
+#: (:mod:`stmgcn_tpu.analysis.dtype_flow`); ``role_dtypes`` keys must
+#: come from here
+PRECISION_SITE_ROLES = (
+    "dot_general",        # MXU operand — bf16 inputs are the point
+    "dot_general_accum",  # MXU accumulator (preferred_element_type)
+    "reduce_sum",         # accumulating reduction (sum/cumsum/add_any)
+    "reduce_order",       # order statistic (max/min) — never accumulates
+    "scan_carry",         # loop-carried state (params/opt-state/stats)
+    "psum",               # cross-device gradient sync operand
+    "normalization",      # variance/norm stat (sqrt/rsqrt chains)
+    "cast",               # explicit convert_element_type boundary
+    "loss",               # the loss output leaf
+    "optimizer_update",   # opt-state output leaves
+    "master_param",       # param input/output leaves
+    "prediction",         # served prediction output leaves
+)
+
+
+@dataclasses.dataclass
+class PrecisionPolicy:
+    """Declarative mixed-precision contract (the bf16 migration's law).
+
+    Pure config math in the established config-before-compute pattern:
+    ``violations()`` is the self-consistency contract behind the
+    ``precision-policy`` lint rule, and the dtype-flow pass
+    (:mod:`stmgcn_tpu.analysis.precision_check`) judges every traced
+    step program's role-classified sites against these knobs. The
+    defaults encode the paper recipe this repo certifies against
+    ("Fast Training of Sparse Graph Neural Networks on Dense
+    Hardware"): bf16 allowed at MXU operands and order statistics, f32
+    mandatory at every accumulation site (dot accumulators, sum
+    reductions, scan carries, psums, normalization stats, loss,
+    optimizer state), f32 master params, and only the f32<->bf16
+    boundary casts whitelisted.
+    """
+
+    #: role -> allowed compute dtype names at sites of that role. Roles
+    #: absent here are ungated by the precision-policy rule (the
+    #: accumulation roles below are gated by accum-dtype instead).
+    role_dtypes: dict = dataclasses.field(default_factory=lambda: {
+        "dot_general": ("float32", "bfloat16"),
+        "dot_general_accum": ("float32",),
+        "reduce_sum": ("float32",),
+        "reduce_order": ("float32", "bfloat16"),
+        "scan_carry": ("float32",),
+        "psum": ("float32",),
+        "normalization": ("float32",),
+        "loss": ("float32",),
+        "optimizer_update": ("float32",),
+        "prediction": ("float32", "bfloat16"),
+    })
+    #: roles where any floating dtype narrower than f32 is the
+    #: ``accum-dtype`` error — the mandatory-f32 accumulation set
+    reduction_f32_roles: tuple = (
+        "reduce_sum", "scan_carry", "psum", "dot_general_accum",
+    )
+    #: dtype the trained parameters (and optimizer moments) live in at
+    #: step boundaries — low-precision *compute* casts down from these,
+    #: never the other way around
+    master_param_dtype: str = "float32"
+    #: ``(src, dst)`` float cast pairs the program may contain; any
+    #: other float->float dtype-changing cast is the ``implicit-cast``
+    #: error (casts *to* float64 are owned by fp64-promotion)
+    cast_whitelist: tuple = (
+        ("float32", "bfloat16"), ("bfloat16", "float32"),
+    )
+
+    def __post_init__(self):
+        # json round-trips hand lists back; canonicalize to tuples
+        self.role_dtypes = {
+            k: tuple(v) for k, v in dict(self.role_dtypes).items()
+        }
+        self.reduction_f32_roles = tuple(self.reduction_f32_roles)
+        self.cast_whitelist = tuple(tuple(p) for p in self.cast_whitelist)
+
+    def allowed(self, role: str) -> Optional[tuple]:
+        """Allowed dtype names for a role, None when the role is ungated."""
+        if role == "master_param":
+            return (self.master_param_dtype,)
+        return self.role_dtypes.get(role)
+
+    def violations(self) -> list:
+        """Every way this policy is self-contradictory (empty = valid).
+
+        A policy that *cannot* certify what it claims — a master dtype
+        the optimizer loses bits in, an accumulation role whose own
+        allowance permits sub-f32, a cast whitelist that legalizes the
+        fp64 promotion another rule bans — is a config bug detectable
+        before any program is walked.
+        """
+        v = []
+        itemsize = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8}
+        if self.master_param_dtype not in PRECISION_FLOAT_DTYPES:
+            v.append(
+                f"master_param_dtype {self.master_param_dtype!r} is not a "
+                f"float dtype name {PRECISION_FLOAT_DTYPES}"
+            )
+        elif itemsize[self.master_param_dtype] < 4:
+            v.append(
+                f"master_param_dtype {self.master_param_dtype!r} is "
+                "narrower than float32 — optimizer updates underflow in "
+                "sub-f32 master params; keep masters wide and cast for "
+                "compute instead"
+            )
+        for role, allowed in self.role_dtypes.items():
+            if role not in PRECISION_SITE_ROLES:
+                v.append(
+                    f"role_dtypes names unknown role {role!r} — the site "
+                    f"taxonomy is {PRECISION_SITE_ROLES}"
+                )
+                continue
+            if not allowed:
+                v.append(f"role_dtypes[{role!r}] allows no dtype at all")
+            for d in allowed:
+                if d not in PRECISION_FLOAT_DTYPES:
+                    v.append(
+                        f"role_dtypes[{role!r}] names unknown float dtype "
+                        f"{d!r}"
+                    )
+        if not self.reduction_f32_roles:
+            v.append(
+                "reduction_f32_roles is empty — with no mandatory-f32 "
+                "accumulation roles a bf16 accumulator certifies clean, "
+                "which defeats the policy's purpose"
+            )
+        for role in self.reduction_f32_roles:
+            if role not in PRECISION_SITE_ROLES:
+                v.append(
+                    f"reduction_f32_roles names unknown role {role!r}"
+                )
+                continue
+            narrow = [
+                d for d in self.role_dtypes.get(role, ())
+                if itemsize.get(d, 4) < 4
+            ]
+            if narrow:
+                v.append(
+                    f"role {role!r} is in reduction_f32_roles (mandatory "
+                    f"f32) but role_dtypes allows {narrow} — the two "
+                    "knobs contradict each other"
+                )
+        for pair in self.cast_whitelist:
+            if len(pair) != 2:
+                v.append(f"cast_whitelist entry {pair!r} is not a (src, dst) pair")
+                continue
+            src, dst = pair
+            bad = [d for d in (src, dst) if d not in PRECISION_FLOAT_DTYPES]
+            if bad:
+                v.append(
+                    f"cast_whitelist pair {pair!r} names unknown float "
+                    f"dtype(s) {bad}"
+                )
+                continue
+            if src == dst:
+                v.append(
+                    f"cast_whitelist pair {pair!r} casts a dtype to itself "
+                    "— not a precision boundary"
+                )
+            if dst == "float64":
+                v.append(
+                    f"cast_whitelist pair {pair!r} whitelists a promotion "
+                    "to float64, which the fp64-promotion rule bans "
+                    "unconditionally (TPUs have no fp64 MXU path)"
+                )
+        return v
+
+
 @dataclasses.dataclass
 class ExperimentConfig:
     name: str = "default"
@@ -760,6 +931,7 @@ class ExperimentConfig:
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
     continual: ContinualConfig = dataclasses.field(default_factory=ContinualConfig)
+    precision: PrecisionPolicy = dataclasses.field(default_factory=PrecisionPolicy)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -776,6 +948,7 @@ class ExperimentConfig:
             obs=ObsConfig(**d.get("obs", {})),
             health=HealthConfig(**d.get("health", {})),
             continual=ContinualConfig(**d.get("continual", {})),
+            precision=PrecisionPolicy(**d.get("precision", {})),
         )
 
 

@@ -23,11 +23,33 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "leaf_dtype_census",
     "to_dense_serving",
     "to_looped_params",
     "to_tiled_serving",
     "to_vmapped_params",
 ]
+
+
+def leaf_dtype_census(tree):
+    """Per-dtype ``{"leaves": n, "bytes": n}`` census of a pytree.
+
+    Works on concrete arrays and abstract ``ShapeDtypeStruct``-likes
+    alike (anything with ``shape``/``dtype``), so the precision lint and
+    the bench rider can census a parameter tree without materializing
+    it. Leaves without a dtype (e.g. Python scalars) count under their
+    numpy-inferred dtype name.
+    """
+    import numpy as np
+
+    census: dict = {}
+    for leaf in jax.tree.leaves(tree):
+        dt = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        shape = getattr(leaf, "shape", ())
+        entry = census.setdefault(dt.name, {"leaves": 0, "bytes": 0})
+        entry["leaves"] += 1
+        entry["bytes"] += int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    return census
 
 _VMAPPED_KEY = "branches"
 

@@ -28,6 +28,7 @@ import optax
 
 __all__ = [
     "FleetSuperstepFns",
+    "PRECISION_ROLES",
     "SeriesSuperstepFns",
     "StepFns",
     "SuperstepFns",
@@ -42,6 +43,60 @@ __all__ = [
 ]
 
 LOSSES = ("mse", "mae", "huber")
+
+#: Precision-role annotations for every registered contract program:
+#: ``program -> (input argument roles, output roles)`` in positional
+#: order, declared HERE (next to the functions whose signatures they
+#: mirror) because the dtype-flow pass cannot infer them — a flattened
+#: jaxpr does not say which invars are master params vs data. The
+#: contract tracer (stmgcn_tpu/analysis/jaxpr_check.py) expands them to
+#: per-leaf labels: ``param``/``opt_state`` expand to their pytree leaf
+#: counts, a trailing-``*`` role absorbs the remaining leaves (checkify
+#: error payloads, health stats), everything else is one leaf. The
+#: labels seed dtype provenance chains (``input:param[3]``) and drive
+#: the master-param / loss boundary checks of the precision pass.
+PRECISION_ROLES = {
+    "serve_bucket": (
+        ("param", "supports", "history"),
+        ("prediction*",),
+    ),
+    "train_step": (
+        ("param", "opt_state", "supports", "window", "target", "mask"),
+        ("param", "opt_state", "loss"),
+    ),
+    "eval_step": (
+        ("param", "supports", "window", "target", "mask"),
+        ("loss", "prediction*"),
+    ),
+    "train_superstep": (
+        ("param", "opt_state", "supports", "window", "target", "index",
+         "mask"),
+        ("param", "opt_state", "loss"),
+    ),
+    "train_series_superstep": (
+        ("param", "opt_state", "supports", "series", "index", "index",
+         "index", "mask"),
+        ("param", "opt_state", "loss"),
+    ),
+    "train_series_superstep_health": (
+        ("param", "opt_state", "supports", "series", "index", "index",
+         "index", "mask"),
+        ("param", "opt_state", "loss", "stats*"),
+    ),
+    "train_fleet_superstep": (
+        ("param", "opt_state", "supports", "series", "index", "index",
+         "index", "mask", "index", "index"),
+        ("param", "opt_state", "loss"),
+    ),
+    "serve_fleet_bucket": (
+        ("param", "supports", "index", "index", "history"),
+        ("prediction*",),
+    ),
+    "train_step_checked": (
+        ("param", "opt_state", "supports", "window", "target", "mask"),
+        ("error*", "param", "opt_state", "loss"),
+    ),
+}
 
 
 def make_optimizer(

@@ -2470,3 +2470,9 @@ class TestLintGateScript:
         assert payload["spmd"]["programs"] > 0
         assert payload["spmd"]["collectives"] > 0
         assert payload["spmd"]["findings"] == 0
+        # the precision dataflow section: every registered contract
+        # program dtype-walked, sites classified, zero policy findings
+        assert payload["precision"]["exit"] == 0
+        assert payload["precision"]["programs"] > 0
+        assert payload["precision"]["sites"] > 0
+        assert payload["precision"]["findings"] == 0

@@ -1,0 +1,380 @@
+"""The precision dataflow pass (analysis.dtype_flow + precision_check).
+
+Three layers, mirroring the analysis suite's structure: (1) every rule
+fires on a seeded known-bad fixture and stays quiet on its known-good
+twin — the bf16 scan carry vs the f32 twin is the canonical pair; (2)
+the policy/census machinery round-trips (PrecisionPolicy.violations(),
+rebaseline against a temp copy, coverage holes); (3) the shipped
+all-fp32 tree is pinned clean: every registered contract program walks,
+zero findings, zero suppressions.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from stmgcn_tpu.analysis.dtype_flow import flow_program, program_flows
+from stmgcn_tpu.analysis.precision_check import (
+    PRECISION_BASELINES,
+    check_flow,
+    check_precision,
+    precision_summary,
+)
+from stmgcn_tpu.config import PrecisionPolicy
+
+
+def _flow(fn, *avals, name="fixture"):
+    return flow_program(name, jax.make_jaxpr(fn)(*avals))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+XS = jax.ShapeDtypeStruct((8,), jnp.float32)
+
+
+class TestAccumDtypeRule:
+    def _scan_sum(self, carry_dtype):
+        def fn(xs):
+            def body(c, x):
+                return c + x.astype(carry_dtype), c
+
+            return jax.lax.scan(body, jnp.zeros((), carry_dtype), xs)
+
+        return fn
+
+    def test_bf16_scan_carry_fires_naming_the_carry(self):
+        flow = _flow(self._scan_sum(jnp.bfloat16), XS, name="bf16_accum")
+        findings = check_flow(flow, PrecisionPolicy())
+        assert _rules(findings) == {"accum-dtype"}
+        [f] = findings
+        # the finding names the exact scan-carry eqn, not just the program
+        carry = next(s for s in flow.sites if s.role == "scan_carry")
+        assert f"eqn #{carry.eqn_index} (scan) carry[0]" in f.message
+        assert "bfloat16" in f.message
+        assert "reduction_f32_roles" in f.message
+        assert f.path == "<contract:precision:bf16_accum>"
+
+    def test_f32_twin_passes(self):
+        flow = _flow(self._scan_sum(jnp.float32), XS, name="f32_twin")
+        assert check_flow(flow, PrecisionPolicy()) == []
+        # same program shape: the twin really does have the same carry
+        assert any(s.role == "scan_carry" for s in flow.sites)
+
+    def test_bf16_cumsum_fires_inside_sub_jaxpr(self):
+        # jnp.cumsum keeps the narrow dtype AND hides the cumsum eqn in
+        # a pjit sub-jaxpr — the recursive walk still classifies it
+        flow = _flow(
+            lambda xs: jnp.cumsum(xs.astype(jnp.bfloat16)), XS, name="csum"
+        )
+        assert _rules(check_flow(flow, PrecisionPolicy())) == {"accum-dtype"}
+
+    def test_jnp_sum_of_bf16_upcasts_and_passes(self):
+        flow = _flow(
+            lambda xs: jnp.sum(xs.astype(jnp.bfloat16)), XS, name="rsum_ok"
+        )
+        assert check_flow(flow, PrecisionPolicy()) == []
+
+    def test_bf16_max_is_order_statistic_not_accumulation(self):
+        flow = _flow(
+            lambda xs: jnp.max(xs.astype(jnp.bfloat16)), XS, name="rmax"
+        )
+        assert check_flow(flow, PrecisionPolicy()) == []
+
+
+class TestImplicitCastRule:
+    def test_unwhitelisted_cast_fires(self):
+        policy = PrecisionPolicy(cast_whitelist=())
+        flow = _flow(lambda x: x.astype(jnp.bfloat16) * 1, XS, name="cast")
+        findings = check_flow(flow, policy)
+        assert _rules(findings) == {"implicit-cast"}
+        assert "float32->bfloat16" in findings[0].message
+        assert "cast_whitelist" in findings[0].message
+
+    def test_whitelisted_cast_passes(self):
+        flow = _flow(lambda x: x.astype(jnp.bfloat16) * 1, XS, name="cast")
+        assert check_flow(flow, PrecisionPolicy()) == []
+
+    def test_f64_cast_belongs_to_fp64_promotion(self):
+        """Promotions to f64 are fp64-promotion's finding (jaxpr_check),
+        never double-reported as implicit-cast."""
+        jax.config.update("jax_enable_x64", True)
+        try:
+            flow = _flow(
+                lambda x: x.astype(jnp.float64), XS, name="to64"
+            )
+        finally:
+            jax.config.update("jax_enable_x64", False)
+        policy = PrecisionPolicy(cast_whitelist=())
+        assert _rules(check_flow(flow, policy)) <= {"precision-policy"}
+        assert "implicit-cast" not in _rules(check_flow(flow, policy))
+        assert any(e["kind"] == "convert" for e in flow.fp64_events)
+
+
+class TestPrecisionPolicyRule:
+    def test_bf16_dot_outside_role_allowance_fires(self):
+        policy = PrecisionPolicy(
+            role_dtypes={"dot_general": ("float32",)},
+            cast_whitelist=(("float32", "bfloat16"),),
+        )
+        a = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+        flow = _flow(
+            lambda m: jnp.matmul(
+                m.astype(jnp.bfloat16), m.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            ),
+            a, name="bf16_dot",
+        )
+        findings = check_flow(flow, policy)
+        assert _rules(findings) == {"precision-policy"}
+        assert any(
+            "role_dtypes['dot_general']" in f.message for f in findings
+        )
+
+    def test_bf16_dot_passes_default_policy(self):
+        """The default policy pre-approves the bf16 migration's compute
+        dtype for dot-general operands — but only with an explicit f32
+        accumulator (``preferred_element_type``); a plain bf16 matmul
+        (bf16-out accumulator) stays an accum-dtype finding."""
+        a = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+        flow = _flow(
+            lambda m: jnp.matmul(
+                m.astype(jnp.bfloat16), m.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            ),
+            a, name="bf16_dot",
+        )
+        assert check_flow(flow, PrecisionPolicy()) == []
+        naked = _flow(
+            lambda m: m.astype(jnp.bfloat16) @ m.astype(jnp.bfloat16),
+            a, name="bf16_dot_naked",
+        )
+        assert _rules(check_flow(naked, PrecisionPolicy())) == {"accum-dtype"}
+
+    def test_master_param_boundary(self):
+        def step(p, x):
+            return p - 0.1 * x.astype(p.dtype), jnp.sum(x)
+
+        p16 = jax.ShapeDtypeStruct((8,), jnp.bfloat16)
+        closed = jax.make_jaxpr(step)(p16, XS)
+        flow = flow_program(
+            "halfmaster", closed,
+            in_labels=("param", "window"), out_labels=("param", "loss"),
+        )
+        findings = check_flow(flow, PrecisionPolicy())
+        assert any(
+            "master_param_dtype" in f.message and "param[0]" in f.message
+            for f in findings
+        )
+
+
+class TestProvenanceChains:
+    def test_chain_names_input_label_and_cast_steps(self):
+        def fn(w, x):
+            return jnp.sum(w.astype(jnp.bfloat16) * x.astype(jnp.bfloat16))
+
+        closed = jax.make_jaxpr(fn)(XS, XS)
+        flow = flow_program(
+            "prov", closed, in_labels=("param", "window")
+        )
+        cast = next(s for s in flow.sites if s.role == "cast")
+        assert cast.provenance[0] == "input:param[0]"
+        assert cast.provenance[-1] == "cast:float32->bfloat16"
+        rendered = cast.describe()
+        assert "input:param[0] -> cast:float32->bfloat16" in rendered
+        assert f"eqn #{cast.eqn_index}" in rendered
+
+    def test_label_arity_mismatch_raises(self):
+        closed = jax.make_jaxpr(lambda x: x)(XS)
+        with pytest.raises(ValueError, match="in_labels"):
+            flow_program("bad", closed, in_labels=("a", "b"))
+
+
+class TestPolicyViolations:
+    def test_default_policy_is_self_consistent(self):
+        assert PrecisionPolicy().violations() == []
+
+    def test_sub_f32_master_fires(self):
+        v = PrecisionPolicy(master_param_dtype="bfloat16").violations()
+        assert any("master_param_dtype" in msg for msg in v)
+
+    def test_unknown_role_fires(self):
+        v = PrecisionPolicy(role_dtypes={"warp_drive": ("float32",)})
+        assert any("warp_drive" in msg for msg in v.violations())
+
+    def test_reduction_allowance_contradiction_fires(self):
+        v = PrecisionPolicy(
+            role_dtypes={"scan_carry": ("bfloat16",)},
+        ).violations()
+        assert any("reduction_f32_roles" in msg for msg in v)
+
+    def test_f64_whitelist_contradicts_fp64_rule(self):
+        v = PrecisionPolicy(
+            cast_whitelist=(("float32", "float64"),)
+        ).violations()
+        assert any("float64" in msg for msg in v)
+
+    def test_violations_become_findings(self):
+        policy = PrecisionPolicy(master_param_dtype="float8")
+        findings = check_precision("smoke", policy=policy, flows={})
+        assert any(
+            f.rule == "precision-policy" and "PrecisionPolicy" in f.message
+            for f in findings
+        )
+
+    def test_json_round_trip_keeps_tuples(self):
+        policy = PrecisionPolicy()
+        thawed = PrecisionPolicy(
+            **json.loads(json.dumps(dataclasses_asdict(policy)))
+        )
+        assert thawed.violations() == []
+        assert thawed.cast_whitelist == policy.cast_whitelist
+
+
+def dataclasses_asdict(policy):
+    import dataclasses
+
+    return dataclasses.asdict(policy)
+
+
+class TestCoverageAndCensus:
+    def test_missing_program_is_a_coverage_finding(self):
+        flows = dict(program_flows("smoke"))
+        flows.pop("train_step")
+        findings = check_precision("smoke", flows=flows)
+        assert any(
+            f.rule == "precision-policy"
+            and "train_step" in f.message
+            and "coverage hole" in f.message
+            for f in findings
+        )
+
+    def test_census_drift_is_a_finding(self):
+        flow = program_flows("smoke")["train_step"]
+        from stmgcn_tpu.analysis.precision_check import _census_findings
+
+        baseline = json.loads(json.dumps(PRECISION_BASELINES["train_step"]))
+        assert _census_findings("train_step", flow.census, baseline) == []
+        baseline["bytes"].pop("float32")
+        drift = _census_findings("train_step", flow.census, baseline)
+        assert any("drifted" in f.message for f in drift)
+        missing = _census_findings("train_step", flow.census, None)
+        assert any("--rebaseline" in f.message for f in missing)
+
+    def test_rebaseline_round_trips_against_copy(self, tmp_path):
+        import stmgcn_tpu.analysis.precision_check as pc
+
+        target = tmp_path / "precision_check_copy.py"
+        target.write_text(open(pc.__file__).read())
+        before = json.loads(json.dumps(PRECISION_BASELINES))
+        try:
+            result = pc.rebaseline_precision(path=str(target))
+            assert result["path"] == str(target)
+            line = next(
+                l for l in target.read_text().splitlines()
+                if l.startswith("PRECISION_BASELINES = ")
+            )
+            ns = {}
+            exec(line, ns)
+            assert ns["PRECISION_BASELINES"] == result["census"]
+            # in-memory baselines updated so later checks see them
+            assert pc.PRECISION_BASELINES == result["census"]
+        finally:
+            pc.PRECISION_BASELINES.clear()
+            pc.PRECISION_BASELINES.update(before)
+
+    def test_missing_literal_raises(self, tmp_path):
+        import stmgcn_tpu.analysis.precision_check as pc
+
+        target = tmp_path / "no_literal.py"
+        target.write_text("x = 1\n")
+        before = json.loads(json.dumps(PRECISION_BASELINES))
+        try:
+            with pytest.raises(RuntimeError, match="PRECISION_BASELINES"):
+                pc.rebaseline_precision(path=str(target))
+        finally:
+            pc.PRECISION_BASELINES.clear()
+            pc.PRECISION_BASELINES.update(before)
+
+
+class TestShippedTreeIsClean:
+    """The tier-1 pin: today's all-fp32 tree pre-certifies clean."""
+
+    def test_every_registered_program_walks_with_zero_findings(self):
+        from stmgcn_tpu.analysis.jaxpr_check import PRIMITIVE_BUDGETS
+
+        flows = program_flows("smoke")
+        assert set(flows) == set(PRIMITIVE_BUDGETS)
+        assert check_precision("smoke", flows=flows) == []
+
+    def test_summary_shape_for_the_gate(self):
+        summary = precision_summary("smoke")
+        assert summary["programs"] == len(program_flows("smoke"))
+        assert summary["sites"] > 0
+        assert summary["findings"] == 0
+
+    def test_zero_suppressions_in_package_source(self):
+        """The precision rules hold with no `# stmgcn: ignore` escape
+        hatches anywhere in the shipped package."""
+        import os
+        import re
+
+        import stmgcn_tpu
+
+        root = os.path.dirname(os.path.abspath(stmgcn_tpu.__file__))
+        pat = re.compile(
+            r"stmgcn:\s*ignore\[(precision-policy|accum-dtype|implicit-cast)"
+        )
+        for dirpath, _, names in os.walk(root):
+            for n in names:
+                if n.endswith(".py"):
+                    with open(os.path.join(dirpath, n)) as f:
+                        assert not pat.search(f.read()), (dirpath, n)
+
+    def test_fp64_scan_shares_the_walk(self):
+        """jaxpr_check's fp64-promotion now consumes the dtype walk's
+        structured events — same walk, byte-identical message format."""
+        from stmgcn_tpu.analysis.jaxpr_check import _check_one
+
+        jax.config.update("jax_enable_x64", True)
+        try:
+            closed = jax.make_jaxpr(
+                lambda x: x.astype(jnp.float64) * 2
+            )(XS)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+        flow = flow_program("fx", closed)
+        via_flow = _check_one("fx", closed, 1, 100, fp64_events=flow.fp64_events)
+        direct = _check_one("fx", closed, 1, 100)
+        assert [str(f) for f in via_flow] == [str(f) for f in direct]
+        assert any(f.rule == "fp64-promotion" for f in via_flow)
+
+
+class TestSarifRuleMetadata:
+    def test_every_rule_has_nonempty_descriptions(self):
+        """The SARIF satellite: every finding-producing rule ships both
+        a shortDescription and a fullDescription, never empty."""
+        from stmgcn_tpu.analysis.report import Finding, render_sarif
+        from stmgcn_tpu.analysis.rules import RULES
+
+        findings = [
+            Finding(rule=rid, path="x.py", line=1, message="m")
+            for rid in RULES
+        ]
+        doc = json.loads(render_sarif(findings))
+        rules = doc["runs"][0]["tool"]["driver"]["rules"]
+        assert len(rules) == len(RULES)
+        for rule in rules:
+            assert rule["shortDescription"]["text"].strip()
+            assert rule["fullDescription"]["text"].strip()
+
+    def test_new_rules_registered_with_long_descriptions(self):
+        from stmgcn_tpu.analysis.rules import RULES
+
+        for rid in ("precision-policy", "accum-dtype", "implicit-cast"):
+            assert rid in RULES
+            assert RULES[rid].severity == "error"
+            assert len(RULES[rid].description) > len(RULES[rid].summary)

@@ -10,7 +10,10 @@ Adam update), on whatever single chip JAX exposes. The record also carries
 kernel} plus ``float32/superstep`` (S train steps fused into one
 ``lax.scan`` dispatch with on-device batch gather, per-step numbers) —
 all numerically equivalent schedules of the same step; the headline is
-the fastest leg.
+the fastest leg. A ``precision_superstep`` rider measures the
+lint-certified bf16 twin program against the fp32 superstep at smoke
+shapes (throughput ratio, final-loss delta, nonfinite census) — the
+ratio is chip evidence only when ``bf16_native`` is true.
 Timing methodology is chained-steps with a single readback fence
 (``stmgcn_tpu.utils.time_chained``): on this image's tunneled TPU backend,
 ``block_until_ready`` does not actually fence and a per-step sync costs a
@@ -546,6 +549,121 @@ def _precision_rider() -> dict:
         "casts": flow.census["casts"],
         "sites": len(flow.sites),
         "param_census": leaf_dtype_census(params),
+    }
+
+
+def _precision_superstep_leg(native_tpu: bool) -> dict:
+    """The mixed-precision leg: the fused window-free superstep at
+    ``precision="bf16"`` (train/step.py's lint-certified twin — bf16
+    matmul operands, f32 accumulation islands, f32 master params) vs the
+    byte-identical-to-before fp32 program, same shapes, same data, same
+    initial state. Reports the per-superstep throughput ratio, the
+    final-loss delta after a short training run, and a nonfinite count
+    over the bf16 run's losses and trained params. Smoke-scale shapes
+    for the same reason as :func:`_health_rider`: the contract is
+    "bf16 twins train stably and cheaply", measurable on any host; the
+    *speedup* claim only means something where bf16 math is real
+    hardware (``native_tpu``) — a CPU host emulates bf16 through f32,
+    so its ratio is recorded with ``bf16_native: false`` and the
+    record-level ``contended`` flag, never as chip evidence."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from stmgcn_tpu.data import DemandDataset, WindowSpec, synthetic_dataset
+    from stmgcn_tpu.models import STMGCN
+    from stmgcn_tpu.ops import SupportConfig
+    from stmgcn_tpu.train import (
+        gather_window_batch,
+        make_optimizer,
+        make_series_superstep_fns,
+        make_step_fns,
+    )
+    from stmgcn_tpu.utils import time_chained
+
+    s_steps, batch = 4, 8
+    data = synthetic_dataset(rows=5, n_timesteps=24 * 7 * 2 + 4 * batch, seed=0)
+    dataset = DemandDataset(data, WindowSpec(SERIAL, DAILY, WEEKLY, 24))
+    supports = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(
+        m_graphs=M_GRAPHS, n_supports=K_SUPPORTS,
+        seq_len=SERIAL + DAILY + WEEKLY, input_dim=dataset.n_feats,
+        lstm_hidden_dim=16, lstm_num_layers=1, gcn_hidden_dim=16,
+    )
+    opt = make_optimizer(2e-3, 1e-4)
+    fns = make_step_fns(model, opt, "mse")
+    horizon = dataset.window.horizon
+    twins = {
+        p: make_series_superstep_fns(
+            model, opt, "mse", horizon=horizon, precision=p
+        )
+        for p in ("fp32", "bf16")
+    }
+
+    series = jnp.asarray(dataset.series_stack())
+    targets = jnp.asarray(dataset.mode_targets("train"))
+    offsets = jnp.asarray(np.asarray(dataset.window.offsets, np.int32))
+    index_rows = [
+        np.asarray(b.indices, np.int32)
+        for b in dataset.batches("train", batch, pad_last=True, with_arrays=False)
+    ]
+    idx = jnp.asarray(
+        np.stack([index_rows[i % len(index_rows)] for i in range(s_steps)])
+    )
+    mask = jnp.ones((s_steps, batch), jnp.float32)
+    x0, _ = gather_window_batch(series, targets, offsets, idx[0], horizon)
+    params0, opt0 = fns.init(jax.random.key(0), jnp.asarray(supports), x0)
+    sup = jnp.asarray(supports)
+
+    # short training run from identical state (copies — the superstep
+    # donates its carry): final loss + nonfinite census per precision
+    def train(sfns, n=5):
+        p = jax.tree.map(jnp.copy, params0)
+        o = jax.tree.map(jnp.copy, opt0)
+        losses = []
+        for _ in range(n):
+            p, o, block = sfns.train_superstep(
+                p, o, sup, series, targets, offsets, idx, mask
+            )
+            losses.append(np.asarray(block))
+        return float(losses[-1][-1]), np.concatenate(losses), jax.device_get(p)
+
+    loss32, all32, _ = train(twins["fp32"])
+    loss16, all16, p16 = train(twins["bf16"])
+    nonfinite = int(np.sum(~np.isfinite(all16))) + sum(
+        int(np.sum(~np.isfinite(np.asarray(leaf, np.float32))))
+        for leaf in jax.tree.leaves(p16)
+    )
+
+    def timed(sfns):
+        state = {
+            "p": jax.tree.map(jnp.copy, params0),
+            "o": jax.tree.map(jnp.copy, opt0),
+        }
+
+        def step():
+            state["p"], state["o"], loss = sfns.train_superstep(
+                state["p"], state["o"], sup, series, targets, offsets, idx, mask
+            )
+            return loss
+
+        return time_chained(step, iters=10, warmup=2)
+
+    t32 = timed(twins["fp32"])
+    t16 = timed(twins["bf16"])
+    return {
+        "s_steps": s_steps,
+        "bf16_native": native_tpu,
+        "superstep_ms_fp32": round(t32 * 1e3, 3),
+        "superstep_ms_bf16": round(t16 * 1e3, 3),
+        "throughput_ratio": round(t32 / t16, 3),
+        "final_loss_fp32": loss32,
+        "final_loss_bf16": loss16,
+        "final_loss_delta": round(abs(loss16 - loss32), 6),
+        "nonfinite": nonfinite,
+        "master_param_dtypes": sorted(
+            {str(np.asarray(leaf).dtype) for leaf in jax.tree.leaves(p16)}
+        ),
     }
 
 
@@ -1426,6 +1544,15 @@ def main() -> None:
         record["precision"] = _precision_rider()
     except Exception as e:  # the precision story must not void the record
         print(f"bench: precision rider failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        # mixed-precision training evidence: bf16-twin vs fp32 superstep
+        # throughput ratio + final-loss delta + nonfinite census (see
+        # _precision_superstep_leg; a CPU host's ratio carries
+        # bf16_native: false and the record's contended flag)
+        record["precision_superstep"] = _precision_superstep_leg(native_tpu)
+    except Exception as e:  # must not void the record
+        print(f"bench: precision superstep leg failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     if probe_err is not None:
         record["platform"] = "cpu-fallback"

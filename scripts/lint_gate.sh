@@ -17,8 +17,8 @@
 #    "nonfinite": N|null},
 #    "spmd": {"exit": N, "programs": N|null, "collectives": N|null,
 #    "findings": N|null},
-#    "precision": {"exit": N, "programs": N|null, "sites": N|null,
-#    "findings": N|null}}
+#    "precision": {"exit": N, "programs": N|null, "bf16_programs": N|null,
+#    "sites": N|null, "findings": N|null}}
 #
 # The "concurrency" section is explicit evidence the static concurrency
 # pass (unguarded-attr / lock-order-cycle / condvar-discipline /
@@ -267,9 +267,12 @@ ok = ok and (spmd.get("programs") or 0) > 0
 ok = ok and spmd.get("findings") == 0
 # precision dataflow pass: every registered contract program dtype-walked
 # (zero programs means the precision certification silently hollowed out)
-# with zero policy/accumulator/cast findings
+# with zero policy/accumulator/cast findings — INCLUDING the bf16 twin
+# programs (zero bf16 programs means the mixed-precision certification
+# dropped out of the registry)
 ok = ok and precision_exit == 0
 ok = ok and (precision.get("programs") or 0) > 0
+ok = ok and (precision.get("bf16_programs") or 0) > 0
 ok = ok and precision.get("findings") == 0
 print(json.dumps({
     "gate": "PASS" if ok else "FAIL",
@@ -312,6 +315,7 @@ print(json.dumps({
     "precision": {
         "exit": precision_exit,
         "programs": precision.get("programs"),
+        "bf16_programs": precision.get("bf16_programs"),
         "sites": precision.get("sites"),
         "findings": precision.get("findings"),
     },

@@ -54,7 +54,7 @@ _walk_eqns = walk_eqns
 #: the count by seq_len), not single-op drift. Keep this a single-line
 #: literal: ``stmgcn lint --rebaseline`` rewrites it in place from the
 #: measured counts (:func:`rebaseline`).
-PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_series_superstep_health": 1310, "train_fleet_superstep": 970, "serve_fleet_bucket": 270, "train_step_checked": 3290}
+PRIMITIVE_BUDGETS = {"serve_bucket": 170, "train_step": 860, "eval_step": 190, "train_superstep": 890, "train_series_superstep": 910, "train_series_superstep_health": 1310, "train_fleet_superstep": 970, "serve_fleet_bucket": 270, "train_step_checked": 3290, "train_step_bf16": 1030, "train_superstep_bf16": 1060, "train_series_superstep_bf16": 1080, "train_fleet_superstep_bf16": 1130}
 
 
 def count_primitives(jaxpr) -> int:
@@ -215,6 +215,24 @@ def _trace_step_programs(preset_name: str = "smoke") -> Dict[str, dict]:
     ffns = make_fleet_superstep_fns(
         model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon
     )
+    # the mixed-precision twins: same factories at precision="bf16"
+    # (f32 master params, bf16 compute shadows — train/step.py). Traced
+    # with stochastic rounding OFF: SR adds rng primitives per leaf and
+    # is a training-run knob, not part of the checked program contract.
+    fns_bf16 = make_step_fns(
+        model, optimizer, loss=cfg.train.loss, precision="bf16"
+    )
+    sfns_bf16 = make_superstep_fns(
+        model, optimizer, loss=cfg.train.loss, precision="bf16"
+    )
+    wfns_bf16 = make_series_superstep_fns(
+        model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon,
+        precision="bf16",
+    )
+    ffns_bf16 = make_fleet_superstep_fns(
+        model, optimizer, loss=cfg.train.loss, horizon=cfg.data.horizon,
+        precision="bf16",
+    )
 
     b = cfg.train.batch_size
     t = cfg.data.serial_len + cfg.data.daily_len + cfg.data.weekly_len
@@ -298,6 +316,24 @@ def _trace_step_programs(preset_name: str = "smoke") -> Dict[str, dict]:
                 model, optimizer, loss=cfg.train.loss, checks="nan"
             )
         )(params, opt_state, sup, x, y, mask),
+        # bf16 twins of the four train programs, traced over the SAME
+        # f32 operand structs as their fp32 counterparts — the program
+        # boundary (master params, optimizer state, data, loss) is f32
+        # by contract; the compute dtype changes inside the jaxpr, where
+        # the dtype-flow pass certifies the f32 accumulation islands
+        "train_step_bf16": jax.make_jaxpr(fns_bf16.train_step)(
+            params, opt_state, sup, x, y, mask
+        ),
+        "train_superstep_bf16": jax.make_jaxpr(sfns_bf16.train_superstep)(
+            params, opt_state, sup, x_all, y_all, idx_block, mask_block
+        ),
+        "train_series_superstep_bf16": jax.make_jaxpr(wfns_bf16.train_superstep)(
+            params, opt_state, sup, series, targets, offsets, idx_block, mask_block
+        ),
+        "train_fleet_superstep_bf16": jax.make_jaxpr(ffns_bf16.train_superstep)(
+            params, opt_state, sup_stack, series, targets, offsets,
+            idx_block, mask_nodes_block, slot_block, nr_block,
+        ),
     }
 
     from stmgcn_tpu.train.step import PRECISION_ROLES

@@ -54,7 +54,7 @@ __all__ = [
 #: values are provenance for census diffs, not gates. Keep this a
 #: single-line literal: ``stmgcn lint --rebaseline`` rewrites it in
 #: place from the measured census (:func:`rebaseline_precision`).
-PRECISION_BASELINES = {'eval_step': {'bytes': {'bool': 3, 'float32': 56788692, 'int32': 48}, 'flops': {'float32': 121699200}, 'casts': 0, 'eqns': 94}, 'serve_bucket': {'bytes': {'bool': 3, 'float32': 28369024, 'int32': 48}, 'flops': {'float32': 60849600}, 'casts': 0, 'eqns': 85}, 'serve_fleet_bucket': {'bytes': {'bool': 1731, 'float32': 41197376, 'int32': 1552}, 'flops': {'float32': 60849600}, 'casts': 2, 'eqns': 133}, 'train_fleet_superstep': {'bytes': {'bool': 118890, 'float32': 146578200, 'int32': 5116}, 'flops': {'float32': 283977600}, 'casts': 4, 'eqns': 483}, 'train_series_superstep': {'bytes': {'bool': 118788, 'float32': 146061284, 'int32': 4700}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 455}, 'train_series_superstep_health': {'bytes': {'bool': 133988, 'float32': 146183392, 'int32': 35252}, 'flops': {'float32': 283977600}, 'casts': 14, 'eqns': 655}, 'train_step': {'bytes': {'bool': 118564, 'float32': 145816468, 'int32': 68}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 430}, 'train_step_checked': {'bytes': {'bool': 11302964, 'float32': 145725276, 'int32': 1296}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 1641}, 'train_superstep': {'bytes': {'bool': 118628, 'float32': 146061284, 'int32': 1096}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 445}}
+PRECISION_BASELINES = {'eval_step': {'bytes': {'bool': 3, 'float32': 56788692, 'int32': 48}, 'flops': {'float32': 121699200}, 'casts': 0, 'eqns': 94}, 'serve_bucket': {'bytes': {'bool': 3, 'float32': 28369024, 'int32': 48}, 'flops': {'float32': 60849600}, 'casts': 0, 'eqns': 85}, 'serve_fleet_bucket': {'bytes': {'bool': 1731, 'float32': 41197376, 'int32': 1552}, 'flops': {'float32': 60849600}, 'casts': 2, 'eqns': 133}, 'train_fleet_superstep': {'bytes': {'bool': 118890, 'float32': 146578200, 'int32': 5116}, 'flops': {'float32': 283977600}, 'casts': 4, 'eqns': 483}, 'train_fleet_superstep_bf16': {'bytes': {'bfloat16': 5636640, 'bool': 118890, 'float32': 145407412, 'int32': 5116}, 'flops': {'float32': 283977600}, 'casts': 86, 'eqns': 565}, 'train_series_superstep': {'bytes': {'bool': 118788, 'float32': 146061284, 'int32': 4700}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 455}, 'train_series_superstep_bf16': {'bytes': {'bfloat16': 5636640, 'bool': 118788, 'float32': 144890496, 'int32': 4700}, 'flops': {'float32': 283977600}, 'casts': 84, 'eqns': 537}, 'train_series_superstep_health': {'bytes': {'bool': 133988, 'float32': 146183392, 'int32': 35252}, 'flops': {'float32': 283977600}, 'casts': 14, 'eqns': 655}, 'train_step': {'bytes': {'bool': 118564, 'float32': 145816468, 'int32': 68}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 430}, 'train_step_bf16': {'bytes': {'bfloat16': 5636640, 'bool': 118564, 'float32': 144645680, 'int32': 68}, 'flops': {'float32': 283977600}, 'casts': 84, 'eqns': 512}, 'train_step_checked': {'bytes': {'bool': 11302964, 'float32': 145725276, 'int32': 1296}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 1641}, 'train_superstep': {'bytes': {'bool': 118628, 'float32': 146061284, 'int32': 1096}, 'flops': {'float32': 283977600}, 'casts': 2, 'eqns': 445}, 'train_superstep_bf16': {'bytes': {'bfloat16': 5636640, 'bool': 118628, 'float32': 144890496, 'int32': 1096}, 'flops': {'float32': 283977600}, 'casts': 84, 'eqns': 527}}
 
 _ITEMSIZE = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8}
 _CAST_HEADROOM = 2.0
@@ -251,11 +251,15 @@ def measured_census(preset_name: str = "smoke") -> Dict[str, dict]:
 
 def precision_summary(preset_name: str = "smoke") -> dict:
     """The lint-gate section: programs walked / sites classified /
-    unsuppressed findings (0 programs or any finding fails the gate)."""
+    unsuppressed findings (0 programs or any finding fails the gate).
+    ``bf16_programs`` counts the mixed-precision twin programs the walk
+    covered — the gate requires it > 0, so the bf16 certification can
+    never silently drop out of the registry."""
     flows = program_flows(preset_name)
     findings = check_precision(preset_name, flows=flows)
     return {
         "programs": len(flows),
+        "bf16_programs": sum(1 for name in flows if name.endswith("_bf16")),
         "sites": sum(len(f.sites) for f in flows.values()),
         "findings": sum(1 for f in findings if not f.suppressed),
     }

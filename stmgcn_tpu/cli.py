@@ -75,6 +75,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--cheb-k", type=int, default=None, help="max polynomial order K")
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default=None)
+    p.add_argument("--precision", choices=("fp32", "bf16"), default=None,
+                   help="step-program compute precision: fp32 (default — "
+                        "bit-identical to the pre-mixed-precision programs) "
+                        "or bf16 (lint-certified mixed-precision twins: bf16 "
+                        "matmul operands, f32 accumulation islands, f32 "
+                        "master params in the optimizer and checkpoints)")
+    p.add_argument("--sr-seed", type=int, default=None, metavar="SEED",
+                   help="stochastically round the master->bf16 param casts "
+                        "with this seed (bf16 only; default: deterministic "
+                        "round-to-nearest-even)")
     p.add_argument("--lstm-backend", choices=("xla", "pallas"), default=None,
                    help="LSTM recurrence implementation: lax.scan (xla) or "
                         "the fused Pallas TPU kernel (pallas)")
@@ -276,6 +286,7 @@ def config_from_args(args) -> "ExperimentConfig":
         ("divergence_action", "divergence_action"),
         ("divergence_patience", "divergence_patience"),
         ("divergence_lr_cut", "divergence_lr_cut"),
+        ("precision", "precision"), ("sr_seed", "sr_seed"),
     ]:
         val = getattr(args, field)
         if val is not None:

@@ -255,6 +255,19 @@ class TrainConfig:
     divergence_patience: int = 3  # consecutive trips before aborting
     #: multiply the learning rate by this factor on each trip (None = off)
     divergence_lr_cut: Optional[float] = None
+    #: step-program compute precision: "fp32" (default — the exact
+    #: pre-mixed-precision programs, bit for bit) | "bf16" (the lint-
+    #: certified mixed-precision twins: bf16 operand casts at every
+    #: matmul/conv use site contracting into f32 accumulation islands;
+    #: the optimizer, its moments, every scan carry, and all checkpoint
+    #: payloads stay f32 masters)
+    precision: str = "fp32"
+    #: seed for stochastically-rounded master->bf16 param casts (None =
+    #: deterministic round-to-nearest-even; bf16 only). SR pre-casts the
+    #: whole param tree at program entry, which moves the LSTM recurrent
+    #: weight-grad scan accumulation to bf16 — a training knob, not a
+    #: registered contract program
+    sr_seed: Optional[int] = None
     seed: int = 0
     out_dir: str = "output"
 

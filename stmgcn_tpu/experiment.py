@@ -494,6 +494,8 @@ def build_trainer(
         grad_clip_norm=t.grad_clip_norm,
         loss=t.loss,
         checks=t.checks,
+        precision=t.precision,
+        sr_seed=t.sr_seed,
         n_epochs=t.epochs,
         batch_size=t.batch_size,
         patience=t.patience,

@@ -27,7 +27,7 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from stmgcn_tpu.ops.chebconv import make_conv
+from stmgcn_tpu.ops.chebconv import accum_dot_general, make_conv
 from stmgcn_tpu.ops.lstm import StackedLSTM
 
 __all__ = ["CGLSTM", "ContextualGate"]
@@ -63,7 +63,9 @@ class ContextualGate(nn.Module):
         the static ``n_real_nodes`` attribute would force a program per
         city. ``None`` keeps the static-attribute behavior.
         """
-        x_seq = obs_seq.sum(axis=-1)  # collapse features (STMGCN.py:36)
+        # collapse features (STMGCN.py:36); reduce in f32 (mandatory-f32
+        # reduction under the precision policy — no-op jaxpr-wise on fp32)
+        x_seq = obs_seq.sum(axis=-1, dtype=jnp.float32).astype(obs_seq.dtype)
         x_nt = x_seq.transpose(0, 2, 1)  # (B, N, T): history as node features
         g = make_conv(
             self.support_mode,
@@ -84,27 +86,35 @@ class ContextualGate(nn.Module):
             # unpadded model so exact-fit cities stay bit-identical to it
             nr = jnp.asarray(n_real)
             node_mask = (jnp.arange(n_nodes) < nr).astype(x_hat.dtype)
-            masked = (x_hat * node_mask[None, :, None]).sum(axis=1) / nr.astype(
-                x_hat.dtype
-            )
-            z = jnp.where(nr == n_nodes, x_hat.mean(axis=1), masked)
+            masked = (x_hat * node_mask[None, :, None]).sum(
+                axis=1, dtype=jnp.float32
+            ) / nr.astype(jnp.float32)
+            z = jnp.where(
+                nr == n_nodes, x_hat.mean(axis=1, dtype=jnp.float32), masked
+            ).astype(x_hat.dtype)
         elif self.n_real_nodes is not None and self.n_real_nodes != n_nodes:
             # eq. 7 over real nodes only (masked mean; a static slice would
             # fight the region sharding, a broadcast-multiply does not)
             node_mask = (jnp.arange(n_nodes) < self.n_real_nodes).astype(x_hat.dtype)
-            z = (x_hat * node_mask[None, :, None]).sum(axis=1) / self.n_real_nodes
+            z = (
+                (x_hat * node_mask[None, :, None]).sum(axis=1, dtype=jnp.float32)
+                / self.n_real_nodes
+            ).astype(x_hat.dtype)
         else:
-            z = x_hat.mean(axis=1)  # eq. 7: average pool over nodes -> (B, T)
+            # eq. 7: average pool over nodes -> (B, T); f32 reduction island
+            z = x_hat.mean(axis=1, dtype=jnp.float32).astype(x_hat.dtype)
 
         fc = nn.Dense(
-            self.seq_len, dtype=self.dtype, param_dtype=self.param_dtype, name="gate_fc"
+            self.seq_len, dtype=self.dtype, param_dtype=self.param_dtype,
+            dot_general=accum_dot_general(self.dtype), name="gate_fc"
         )
         inner = fc(z)
         second = (
             fc
             if self.shared_gate_fc
             else nn.Dense(
-                self.seq_len, dtype=self.dtype, param_dtype=self.param_dtype, name="gate_fc2"
+                self.seq_len, dtype=self.dtype, param_dtype=self.param_dtype,
+                dot_general=accum_dot_general(self.dtype), name="gate_fc2"
             )
         )
         s = nn.sigmoid(second(nn.relu(inner)))  # eq. 8

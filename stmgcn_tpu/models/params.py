@@ -23,7 +23,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "compute_cast",
     "leaf_dtype_census",
+    "sr_cast_bf16",
     "to_dense_serving",
     "to_looped_params",
     "to_tiled_serving",
@@ -50,6 +52,81 @@ def leaf_dtype_census(tree):
         entry["leaves"] += 1
         entry["bytes"] += int(np.prod(shape, dtype=np.int64)) * dt.itemsize
     return census
+
+def _round_to_bf16_stochastic(x, noise):
+    """Truncate ``f32 -> bf16`` after adding uniform mantissa noise.
+
+    bf16 is f32 with the low 16 mantissa bits dropped; adding
+    ``U[0, 2^16)`` to the raw bits before masking them off makes the
+    truncation round up with probability proportional to the discarded
+    fraction — an unbiased rounding whose *expected* value is the f32
+    input (plain round-to-nearest is biased toward representable
+    values, which a long optimizer trajectory can integrate into drift).
+    """
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(rounded, jnp.float32).astype(jnp.bfloat16)
+
+
+@jax.custom_vjp
+def sr_cast_bf16(x, noise):
+    """Stochastically-rounded ``f32 -> bf16`` cast with straight-through grad.
+
+    ``noise`` is a ``uint32`` array of ``x``'s shape holding
+    ``U[0, 2^16)`` draws (``jax.random.randint``). The backward pass is
+    the plain cast's: cotangents convert to f32 (identity/straight-
+    through), ``None`` for the noise.
+    """
+    return _round_to_bf16_stochastic(x, noise)
+
+
+def _sr_cast_fwd(x, noise):
+    return _round_to_bf16_stochastic(x, noise), None
+
+
+def _sr_cast_bwd(_res, g):
+    return (g.astype(jnp.float32), None)
+
+
+sr_cast_bf16.defvjp(_sr_cast_fwd, _sr_cast_bwd)
+
+
+def compute_cast(tree, dtype, rng=None):
+    """Cast the float leaves of a pytree to the compute ``dtype``.
+
+    The master/compute split of mixed-precision training: the optimizer
+    holds f32 masters and each step regenerates this low-precision
+    shadow inside the loss closure, so autodiff returns f32 cotangents
+    at the cast boundary. Non-float leaves (index tables, counters)
+    pass through untouched. With ``rng`` (and ``dtype=bfloat16``) the
+    cast is stochastically rounded via :func:`sr_cast_bf16`, one
+    ``fold_in``-derived noise stream per leaf.
+    """
+    dtype = jnp.dtype(dtype)
+
+    def _is_float(leaf):
+        return jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+
+    if rng is None:
+        return jax.tree.map(
+            lambda leaf: leaf.astype(dtype) if _is_float(leaf) else leaf, tree
+        )
+    if dtype != jnp.bfloat16:
+        raise ValueError(
+            f"stochastic rounding is defined for bfloat16 only, got {dtype}"
+        )
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if _is_float(leaf):
+            noise = jax.random.randint(
+                jax.random.fold_in(rng, i), jnp.shape(leaf), 0, 1 << 16,
+                dtype=jnp.uint32,
+            )
+            leaf = sr_cast_bf16(leaf, noise)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
 
 _VMAPPED_KEY = "branches"
 

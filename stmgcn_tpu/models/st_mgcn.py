@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from stmgcn_tpu.models.cg_lstm import CGLSTM
-from stmgcn_tpu.ops.chebconv import make_conv
+from stmgcn_tpu.ops.chebconv import accum_dot_general, make_conv
 
 __all__ = ["STMGCN", "Branch"]
 
@@ -251,7 +251,8 @@ class STMGCN(nn.Module):
                 spmd_axis_name=spmd,
             )(**self._branch_kwargs(modes[0]), name="branches")
             feats = branches(supports_stack, obs_seq, n_real)  # (M, B, N, gcn_hidden)
-            fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
+            # aggregation (STMGCN.py:116); f32 reduction island (no-op on fp32)
+            fused = feats.sum(axis=0, dtype=jnp.float32).astype(feats.dtype)
         elif not all_dense or not self.vmap_branches:
             feats = [
                 Branch(**self._branch_kwargs(modes[m]), name=f"branch_{m}")(
@@ -259,7 +260,8 @@ class STMGCN(nn.Module):
                 )
                 for m in range(self.m_graphs)
             ]
-            fused = sum(feats)  # aggregation (STMGCN.py:116)
+            # aggregation (STMGCN.py:116); f32 reduction island (no-op on fp32)
+            fused = sum(f.astype(jnp.float32) for f in feats).astype(feats[0].dtype)
         else:
             branches = nn.vmap(
                 Branch,
@@ -269,13 +271,20 @@ class STMGCN(nn.Module):
                 split_rngs={"params": True},
             )(**self._branch_kwargs(), name="branches")
             feats = branches(supports_stack, obs_seq, n_real)  # (M, B, N, gcn_hidden)
-            fused = feats.sum(axis=0)  # aggregation (STMGCN.py:116)
+            # aggregation (STMGCN.py:116); f32 reduction island (no-op on fp32)
+            fused = feats.sum(axis=0, dtype=jnp.float32).astype(feats.dtype)
         out = nn.Dense(
             self.horizon * self.input_dim,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
+            dot_general=accum_dot_general(self.dtype),
             name="head",
         )(fused)
+        if self.dtype is not None:
+            # the head's dot_general hands back its f32 accumulator (bias
+            # add included); the prediction leaves in the module compute
+            # dtype — a no-op convert on fp32, bf16 at the serve boundary
+            out = out.astype(self.dtype)
         if self.horizon == 1:
             return out  # (B, N, C) — reference-shaped next-step prediction
         batch, n_nodes = out.shape[:2]

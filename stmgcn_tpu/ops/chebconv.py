@@ -16,9 +16,12 @@ reference-trained weights map 1:1. Xavier-normal weight init and zero bias
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 __all__ = [
@@ -26,9 +29,37 @@ __all__ = [
     "ChebGraphConv",
     "SparseChebGraphConv",
     "TiledChebGraphConv",
+    "accum_dot_general",
     "conv_cls",
     "make_conv",
 ]
+
+
+def accum_dot_general(dtype):
+    """A ``dot_general`` for ``nn.Dense(dot_general=...)`` pinning f32 MXU
+    accumulation under a sub-f32 compute dtype.
+
+    Returns ``None`` (flax's default contraction) when ``dtype`` is
+    ``None`` or already >= 32-bit, so fp32 programs keep their exact
+    pre-mixed-precision jaxprs; for bf16 the returned contraction runs
+    ``bf16 x bf16`` with ``preferred_element_type=f32`` and returns the
+    f32 accumulator as-is. Keeping the Dense output f32 means the bias
+    add (and any elementwise tail) runs in f32 too — so the *backward*
+    bias reduction is an f32 ``reduce_sum``, which the precision lint
+    requires. The next matmul's operand cast re-narrows to bf16.
+    """
+    # static (construction-time) dtype metadata, not a traced value
+    if dtype is None or np.dtype(dtype).itemsize >= 4:
+        return None
+
+    def _dot_general(lhs, rhs, dimension_numbers, precision=None,
+                     preferred_element_type=None):
+        return jax.lax.dot_general(
+            lhs, rhs, dimension_numbers, precision=precision,
+            preferred_element_type=jnp.float32,
+        )
+
+    return _dot_general
 
 
 def conv_cls(mode):
@@ -81,13 +112,23 @@ def _conv_params(mod, f_in: int):
 
 
 def _project(stacked, w, b, activation):
-    """Shared projection/bias/activation tail of both conv variants."""
-    out = stacked @ w
+    """Shared projection/bias/activation tail of both conv variants.
+
+    The matmul accumulates f32 regardless of the compute dtype
+    (``preferred_element_type``) and bias/activation ride the f32
+    accumulator before one downcast at the end — a no-op chain on the
+    fp32 path (jaxpr-identical), the mandatory accumulation island on
+    bf16. Adding the bias on the f32 side matters for the *backward*
+    pass: the bias gradient is a ``reduce_sum`` of the add's cotangent,
+    which this ordering keeps f32 (the precision lint forbids bf16
+    reduction accumulators).
+    """
+    out = jnp.matmul(stacked, w, preferred_element_type=jnp.float32)
     if b is not None:
         out = out + b
     if activation is not None:
         out = activation(out)
-    return out
+    return out.astype(stacked.dtype)
 
 
 class ChebGraphConv(nn.Module):
@@ -115,7 +156,11 @@ class ChebGraphConv(nn.Module):
         supports, x, w, b = nn.dtypes.promote_dtype(supports, x, w, b, dtype=self.dtype)
 
         # All K propagations at once; k-major flatten == torch.cat order.
-        propagated = jnp.einsum("kij,bjf->bikf", supports, x)
+        # f32 accumulation island: bf16 operands contract with f32
+        # accumulators (fp32 path: jaxpr-identical no-ops).
+        propagated = jnp.einsum(
+            "kij,bjf->bikf", supports, x, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
         stacked = propagated.reshape(batch, n_nodes, self.n_supports * f_in)
         return _project(stacked, w, b, self.activation)
 
@@ -180,8 +225,26 @@ class SparseChebGraphConv(nn.Module):
         # (B, N, F) -> (N, B*F): propagate all batch/features per support
         x_mat = x.transpose(1, 0, 2).reshape(n_nodes, batch * f_in)
         if isinstance(supports, BlockSparseStack):
+            if supports.data.dtype != x.dtype:
+                # sub-f32 compute: block values join the signal's dtype so
+                # the kernel's tile matmuls run bf16 x bf16 (its accumulators
+                # and out_shape stay f32 — the island is inside the kernel)
+                supports = dataclasses.replace(
+                    supports,
+                    data=supports.data.astype(x.dtype),
+                    data_t=supports.data_t.astype(x.dtype),
+                )
             propagated = spmm_stack(supports, x_mat).astype(x.dtype)  # one launch
         else:
+            if supports and supports[0].data.dtype != x.dtype:
+                supports = [
+                    dataclasses.replace(
+                        bs,
+                        data=bs.data.astype(x.dtype),
+                        data_t=bs.data_t.astype(x.dtype),
+                    )
+                    for bs in supports
+                ]
             # kernel accumulates fp32; cast back to the compute dtype
             propagated = jnp.stack(
                 [spmm(bs, x_mat).astype(x.dtype) for bs in supports], axis=0
@@ -303,6 +366,15 @@ class TiledChebGraphConv(nn.Module):
             raise ValueError(f"x has {n_nodes} nodes, plan expects {supports.n}")
         w, b = _conv_params(self, f_in)
         x, w, b = nn.dtypes.promote_dtype(x, w, b, dtype=self.dtype)
+        if supports.data.dtype != x.dtype:
+            # sub-f32 compute: tile values join the signal's dtype so the
+            # block contractions (gathered-tiles einsum / Pallas kernel)
+            # run bf16 x bf16 against their f32 accumulators
+            supports = dataclasses.replace(
+                supports,
+                data=supports.data.astype(x.dtype),
+                data_t=supports.data_t.astype(x.dtype),
+            )
 
         # (B, N, F) -> (N, B*F), then ONE permute into the plan's order
         x_mat = x.transpose(1, 0, 2).reshape(n_nodes, batch * f_in)

@@ -144,10 +144,21 @@ class StackedLSTM(nn.Module):
         inputs = x
         for layer in range(self.num_layers):
             wx, wh, b = self._layer_params(layer, inputs.shape[-1])
-            inputs, wx, wh, b = nn.dtypes.promote_dtype(inputs, wx, wh, b, dtype=self.dtype)
+            # wh is deliberately NOT promoted here: it stays master
+            # (param) dtype in the scan closure and casts to the compute
+            # dtype INSIDE the step body, so the cast's VJP converts each
+            # step's cotangent to f32 before the backward scan
+            # accumulates it — the recurrent weight-grad accumulator (a
+            # backward scan carry) must be f32 under the precision policy
+            inputs, wx, b = nn.dtypes.promote_dtype(inputs, wx, b, dtype=self.dtype)
+            compute_dtype = wx.dtype
 
-            # Hoisted input projection: one (B, T, 4H) matmul outside the scan.
-            x_proj = inputs @ wx + b
+            # Hoisted input projection: one (B, T, 4H) matmul outside the
+            # scan. f32 accumulation island (no-op on fp32): under a bf16
+            # compute dtype the contraction runs bf16 x bf16 with f32
+            # accumulators and x_proj — hence the (h, c) scan carries
+            # seeded from its dtype below — stays f32.
+            x_proj = jnp.matmul(inputs, wx, preferred_element_type=jnp.float32) + b
 
             if initial_states is not None:
                 h0, c0 = initial_states[layer]
@@ -155,9 +166,16 @@ class StackedLSTM(nn.Module):
                 h0 = jnp.zeros((batch, h_dim), x_proj.dtype)
                 c0 = jnp.zeros((batch, h_dim), x_proj.dtype)
 
-            def step(carry, xt, wh=wh):
+            def step(carry, xt, wh=wh, cdt=compute_dtype):
                 h, c = carry
-                h, c = self._cell(xt + h @ wh, c)
+                # recurrent matmul in the compute dtype with f32
+                # accumulation; the f32 carry only drops precision at the
+                # MXU operand boundary, never in the gate/state arithmetic
+                gates = xt + jnp.matmul(
+                    h.astype(cdt), wh.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+                h, c = self._cell(gates, c)
                 return (h, c), h
 
             if self.remat:
@@ -212,12 +230,28 @@ class StackedLSTM(nn.Module):
         """All layers in one scan; only the top layer's sequence is kept."""
         batch = x.shape[0]
         h_dim = self.hidden_dim
-        x, params = self._collect_params(x)
+        params = []
+        in_dim = x.shape[-1]
+        for layer in range(self.num_layers):
+            params.append(self._layer_params(layer, in_dim))
+            in_dim = h_dim
+        # Only the activations promote to the compute dtype: every layer
+        # weight consumed INSIDE the scan stays master (param) dtype in
+        # the closure and casts at its in-step use site, so each step's
+        # weight cotangent converts to f32 before the backward scan
+        # accumulates it (same argument as the layered path's wh).
+        (x,) = nn.dtypes.promote_dtype(x, dtype=self.dtype)
+        cdt = x.dtype
 
         # Layer 0's input projection is still hoisted; deeper layers consume
-        # the previous layer's fresh h inside the step.
+        # the previous layer's fresh h inside the step. f32 accumulation
+        # island as on the layered path: under bf16 compute the (h, c)
+        # carries seeded from x_proj0's dtype stay f32.
         wx0, _, b0 = params[0]
-        x_proj0 = x @ wx0 + b0
+        x_proj0 = (
+            jnp.matmul(x, wx0.astype(cdt), preferred_element_type=jnp.float32)
+            + b0
+        )
 
         # Layers >= 1 cannot hoist their input projection (it consumes the
         # lower layer's fresh h), so their step does BOTH matmuls — packed
@@ -249,17 +283,40 @@ class StackedLSTM(nn.Module):
         def step(carry, xt0):
             new_states = []
             inp = None
+            # Every per-step matmul casts BOTH operands (activation and
+            # master-dtype weight) to the compute dtype in the body and
+            # accumulates in f32, so gate and state arithmetic — and
+            # therefore the scan carries, forward and backward — stay f32
+            # under a bf16 compute dtype (no-op jaxpr-wise on fp32).
+            # Biases stay f32 and add on the f32 accumulator side.
             for layer, (h, c) in enumerate(carry):
                 if layer == 0:
-                    gates = xt0 + h @ params[0][1]
+                    gates = xt0 + jnp.matmul(
+                        h.astype(cdt), params[0][1].astype(cdt),
+                        preferred_element_type=jnp.float32,
+                    )
                 elif pack:
                     gates = (
-                        jnp.concatenate([inp, h], axis=-1) @ wxh[layer - 1]
+                        jnp.matmul(
+                            jnp.concatenate([inp, h], axis=-1).astype(cdt),
+                            wxh[layer - 1].astype(cdt),
+                            preferred_element_type=jnp.float32,
+                        )
                         + params[layer][2]
                     )
                 else:
                     wx, wh, b = params[layer]
-                    gates = inp @ wx + b + h @ wh
+                    gates = (
+                        jnp.matmul(
+                            inp.astype(cdt), wx.astype(cdt),
+                            preferred_element_type=jnp.float32,
+                        )
+                        + b
+                        + jnp.matmul(
+                            h.astype(cdt), wh.astype(cdt),
+                            preferred_element_type=jnp.float32,
+                        )
+                    )
                 h, c = self._cell(gates, c)
                 new_states.append((h, c))
                 inp = h

@@ -49,6 +49,7 @@ __all__ = [
     "TiledBranchSupports",
     "TiledSupports",
     "gathered_tiles_apply",
+    "gathered_tiles_apply_reference",
     "plan_tiling",
     "rcm_permutation",
 ]
@@ -363,6 +364,43 @@ def plan_tiling(dense, tile: int = TILE) -> TiledSupports:
     )
 
 
+def _gathered_tiles_fwd_call(data, idx, x_mat, n, tile):
+    """Gather signal row blocks by ``idx`` + one batched tile contraction.
+
+    ``idx`` entries are in-bounds by construction (block condensation
+    emits ``[0, R)`` only), so the gather clips instead of paying
+    ``jnp.take``'s negative-index select chain.
+    """
+    k, r, _ = idx.shape
+    n_pad = r * tile
+    x_pad = jnp.pad(x_mat, ((0, n_pad - x_mat.shape[0]), (0, 0)))
+    x_blocks = x_pad.reshape(r, tile, x_mat.shape[1])
+    gathered = jnp.take(x_blocks, idx, axis=0, mode="clip")  # (K, R, C, tile, BF)
+    out = jnp.einsum(
+        "krcij,krcjf->krif", data, gathered,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(k, n_pad, x_mat.shape[1])[:, :n]
+
+
+def _gathered_tiles_bwd_call(data_t, idx_t, g, n, tile):
+    """Prepared backward: ``dx = sum_k A_k^T @ g_k`` over the offline
+    pre-transposed block stacks — the same gather/contract shape as the
+    forward, with the k axis folded into the accumulation."""
+    k, r, _ = idx_t.shape
+    n_pad = r * tile
+    g_pad = jnp.pad(g, ((0, 0), (0, n_pad - g.shape[1]), (0, 0)))
+    g_blocks = g_pad.reshape(k, r, tile, g.shape[2])
+    gathered = jax.vmap(
+        lambda blocks, it: jnp.take(blocks, it, axis=0, mode="clip")
+    )(g_blocks, idx_t)  # (K, R, C_t, tile, BF)
+    dx = jnp.einsum(
+        "krcij,krcjf->rif", data_t, gathered,
+        preferred_element_type=jnp.float32,
+    )
+    return dx.reshape(n_pad, g.shape[2])[:n]
+
+
 def gathered_tiles_apply(branch: TiledBranchSupports, x_mat: jnp.ndarray) -> jnp.ndarray:
     """``out[k] = A_k @ x`` through pure gather + batched matmul XLA ops.
 
@@ -372,19 +410,49 @@ def gathered_tiles_apply(branch: TiledBranchSupports, x_mat: jnp.ndarray) -> jnp
     accumulation (``preferred_element_type`` mirrors the kernel's MXU
     accumulate). Measurable on the 1-core CPU-fallback host, where
     interpret-mode Pallas is orders of magnitude off. ``x_mat`` is the
-    *permuted* ``(N, BF)`` signal; returns ``(K, N, BF)`` f32. Gradients
-    flow to ``x_mat`` only in practice (supports are never params), via
-    the transpose of gather — no dense ``(N, N)`` form is ever built.
+    *permuted* ``(N, BF)`` signal; returns ``(K, N, BF)`` f32.
+
+    **Prepared backward** (execution-path-preparing, PAPERS.md): instead
+    of the autodiff-derived transpose — a scatter-add of cotangent tiles
+    back through the gather — the custom VJP consumes the pre-transposed
+    block stacks ``plan_tiling`` already builds (``data_t``/``idx_t``)
+    and runs the *same* gathered-tiles SpMM shape over the cotangent:
+    ``dx = sum_k A_k^T @ g_k``, offline-prepared layout, no scatter.
+    Gradients flow to ``x_mat`` only (supports are offline constants —
+    zero support cotangents by design, like :func:`~stmgcn_tpu.ops.spmm
+    .spmm_stack`): the VJP closes over the support stacks so ``x`` is
+    its sole primal, which keeps the backward jaxpr free of
+    materialized zero cotangents for the four structure arrays.
+    :func:`gathered_tiles_apply_reference` keeps the plain-autodiff
+    body for parity tests.
     """
-    k, r, c = branch.idx.shape
-    tile = branch.tile
-    n_pad = r * tile
-    x_pad = jnp.zeros((n_pad, x_mat.shape[1]), x_mat.dtype)
-    x_pad = x_pad.at[: x_mat.shape[0]].set(x_mat)
-    x_blocks = x_pad.reshape(r, tile, x_mat.shape[1])
-    gathered = jnp.take(x_blocks, branch.idx, axis=0)  # (K, R, C, tile, BF)
-    out = jnp.einsum(
-        "krcij,krcjf->krif", branch.data, gathered,
-        preferred_element_type=jnp.float32,
+    data, idx = branch.data, branch.idx
+    data_t, idx_t = branch.data_t, branch.idx_t
+    n, tile = branch.n, branch.tile
+    x_dtype = x_mat.dtype
+
+    @jax.custom_vjp
+    def _apply(x):
+        return _gathered_tiles_fwd_call(data, idx, x, n, tile)
+
+    def _fwd(x):
+        return _gathered_tiles_fwd_call(data, idx, x, n, tile), None
+
+    def _bwd(_res, g):
+        # f32-accumulated prepared aggregation -> cotangent back in the
+        # primal's dtype (no-op on the f32 path)
+        return (_gathered_tiles_bwd_call(data_t, idx_t, g, n, tile).astype(x_dtype),)
+
+    _apply.defvjp(_fwd, _bwd)
+    return _apply(x_mat)
+
+
+def gathered_tiles_apply_reference(
+    branch: TiledBranchSupports, x_mat: jnp.ndarray
+) -> jnp.ndarray:
+    """The same forward with plain autodiff (scatter-add backward) — the
+    oracle the prepared backward is parity- and primitive-count-tested
+    against (tests/test_mixed_precision.py)."""
+    return _gathered_tiles_fwd_call(
+        branch.data, branch.idx, x_mat, branch.n, branch.tile
     )
-    return out.reshape(k, n_pad, x_mat.shape[1])[:, : branch.n]

@@ -28,6 +28,7 @@ import optax
 
 __all__ = [
     "FleetSuperstepFns",
+    "PRECISIONS",
     "PRECISION_ROLES",
     "SeriesSuperstepFns",
     "StepFns",
@@ -43,6 +44,14 @@ __all__ = [
 ]
 
 LOSSES = ("mse", "mae", "huber")
+
+#: training compute dtypes the step factories can build programs for.
+#: "fp32" is the default and traces to EXACTLY the pre-mixed-precision
+#: program (byte-identical jaxprs — the pinned primitive budgets enforce
+#: this); "bf16" casts params/activations to bfloat16 at program entry
+#: while the optimizer state, loss, reductions and scan carries stay f32
+#: (the f32 accumulation islands annotated throughout ops/ and models/).
+PRECISIONS = ("fp32", "bf16")
 
 #: Precision-role annotations for every registered contract program:
 #: ``program -> (input argument roles, output roles)`` in positional
@@ -95,6 +104,30 @@ PRECISION_ROLES = {
     "train_step_checked": (
         ("param", "opt_state", "supports", "window", "target", "mask"),
         ("error*", "param", "opt_state", "loss"),
+    ),
+    # bf16 twins: same signatures as their fp32 counterparts — the
+    # master params / optimizer state / loss boundary stays f32 (the
+    # whole point of the master/compute split), only the in-program
+    # compute dtype differs, which the dtype-flow pass reads off the
+    # jaxpr itself.
+    "train_step_bf16": (
+        ("param", "opt_state", "supports", "window", "target", "mask"),
+        ("param", "opt_state", "loss"),
+    ),
+    "train_superstep_bf16": (
+        ("param", "opt_state", "supports", "window", "target", "index",
+         "mask"),
+        ("param", "opt_state", "loss"),
+    ),
+    "train_series_superstep_bf16": (
+        ("param", "opt_state", "supports", "series", "index", "index",
+         "index", "mask"),
+        ("param", "opt_state", "loss"),
+    ),
+    "train_fleet_superstep_bf16": (
+        ("param", "opt_state", "supports", "series", "index", "index",
+         "index", "mask", "index", "index"),
+        ("param", "opt_state", "loss"),
     ),
 }
 
@@ -300,26 +333,35 @@ def _health_stats(params, grads, updates, loss_val):
     is ‖Δparam‖/‖param‖, the classic learning-dynamics gauge (~1e-3
     healthy; ~1 means the optimizer is overwriting the model).
     """
+    # Norm math runs in f32 regardless of the leaves' dtype: a bf16
+    # sum-of-squares overflows at ~2e19 (max bf16 ~3.4e38, but the
+    # squares sum across millions of elements) and quantizes the band
+    # checks the promotion gate reads. Same-dtype astype is a no-op
+    # jaxpr-wise, so the fp32 health program is byte-identical.
+    f32 = lambda t: jax.tree.map(lambda leaf: leaf.astype(jnp.float32), t)
     names = health_group_names(grads)
-    inner = grads["params"] if names and "params" in grads else grads
+    grads32 = f32(grads)
+    inner = grads32["params"] if names and "params" in grads32 else grads32
     group = (
         jnp.stack([optax.global_norm(inner[k]) for k in names])
         if names else jnp.zeros((0,), jnp.float32)
     )
+    # nonfinite counting stays on the RAW grads: casting first could
+    # overflow a finite bf16 value's square, not the value itself
     nonfinite = sum(
         jnp.sum(~jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)
     )
     return {
-        "grad_norm": optax.global_norm(grads),
-        "update_ratio": optax.global_norm(updates)
-        / jnp.maximum(optax.global_norm(params), 1e-12),
+        "grad_norm": optax.global_norm(grads32),
+        "update_ratio": optax.global_norm(f32(updates))
+        / jnp.maximum(optax.global_norm(f32(params)), 1e-12),
         "nonfinite_grads": jnp.asarray(nonfinite, jnp.int32),
         "nonfinite_loss": (~jnp.isfinite(loss_val)).astype(jnp.int32),
         "group_norms": group,
     }
 
 
-def _raw_step_bodies(model, optimizer, loss: str):
+def _raw_step_bodies(model, optimizer, loss: str, precision: str = "fp32"):
     """The unjitted init/train/eval bodies shared by :func:`make_step_fns`
     and :func:`make_superstep_fns`.
 
@@ -333,11 +375,48 @@ def _raw_step_bodies(model, optimizer, loss: str):
     those, and ``train_step`` dropping them adds no primitives
     (``jax.make_jaxpr`` performs no DCE, so the plain program's jaxpr is
     unchanged — the ``train_series_superstep`` budget pins this).
+
+    ``precision="bf16"`` builds the mixed-precision twin of the same
+    body: the ``params`` argument stays the f32 *master* copy the
+    optimizer owns, and the model is cloned to ``dtype=bfloat16`` so
+    every matmul/conv casts its operands (master-dtype weights AND
+    activations) to bf16 at the *use site* and contracts with
+    ``preferred_element_type=f32`` — the f32 accumulation islands
+    annotated in ops/ and models/. Use-site casting (rather than one
+    whole-tree cast at entry) is what keeps the BACKWARD pass clean
+    too: each cast's VJP converts cotangents to f32 right where they
+    are produced, so bias-grad reductions, fan-out ``add_any``
+    accumulations, and the LSTM backward scan's weight-grad carries are
+    all f32 — the precision lint certifies this per program. Grads,
+    Adam moments, updates and the loss are therefore f32 end to end;
+    ``precision`` selects at *trace* time (a Python branch), so the
+    fp32 program is byte-identical to the pre-mixed-precision one.
+
+    The trailing ``sr_rng`` of the train bodies is an optional PRNG key
+    enabling stochastically-rounded master->shadow casts: when set, the
+    whole param tree is cast to bf16 at program entry via
+    ``models/params.py:compute_cast`` (SR noise must be drawn once per
+    leaf per step, which has no use-site analogue). The tradeoff is
+    explicit: under SR the LSTM's recurrent weight-grad accumulation
+    rides the backward scan carry in bf16 — SR programs are a training
+    knob, not registered contract programs. ``None`` (and fp32) adds
+    nothing to the jaxpr.
     """
     if loss not in LOSSES:
         raise ValueError(f"loss must be one of {LOSSES}, got {loss!r}")
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {PRECISIONS}, got {precision!r}"
+        )
+    bf16 = precision == "bf16"
+    if bf16:
+        from stmgcn_tpu.models.params import compute_cast
 
-    def loss_fn(params, supports, x, y, mask, n_real=None):
+        model = model.clone(dtype=jnp.bfloat16)
+
+    def loss_fn(params, supports, x, y, mask, n_real=None, sr_rng=None):
+        if bf16 and sr_rng is not None:
+            params = compute_cast(params, jnp.bfloat16, sr_rng)
         pred = model.apply(params, supports, x, n_real)
         err = _elementwise_loss(loss, pred.astype(jnp.float32), y.astype(jnp.float32))
         # y is (B, N, C) single-step or (B, H, N, C) seq2seq
@@ -354,17 +433,21 @@ def _raw_step_bodies(model, optimizer, loss: str):
         params = model.init(rng, supports, x)
         return params, optimizer.init(params)
 
-    def train_step_full(params, opt_state, supports, x, y, mask, n_real=None):
+    def train_step_full(
+        params, opt_state, supports, x, y, mask, n_real=None, sr_rng=None
+    ):
         (loss_val, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, supports, x, y, mask, n_real
+            params, supports, x, y, mask, n_real, sr_rng
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, opt_state, loss_val, grads, updates, params
 
-    def train_step(params, opt_state, supports, x, y, mask, n_real=None):
+    def train_step(
+        params, opt_state, supports, x, y, mask, n_real=None, sr_rng=None
+    ):
         params, opt_state, loss_val, _, _, _ = train_step_full(
-            params, opt_state, supports, x, y, mask, n_real
+            params, opt_state, supports, x, y, mask, n_real, sr_rng
         )
         return params, opt_state, loss_val
 
@@ -381,6 +464,8 @@ def make_step_fns(
     loss: str = "mse",
     checks: str | None = None,
     health: bool = False,
+    precision: str = "fp32",
+    sr_seed: Optional[int] = None,
 ) -> StepFns:
     """Build jitted init/train/eval steps for a flax model.
 
@@ -407,17 +492,37 @@ def make_step_fns(
     :func:`_health_stats` dict read off the grads/updates the step
     already computed. The params/opt-state/loss math is the *same*
     shared body, so results are bit-identical to the plain step.
+
+    ``precision="bf16"`` builds the mixed-precision twin (see
+    :func:`_raw_step_bodies`): f32 master params in/out, bf16 compute
+    shadow per step. ``sr_seed`` (bf16 only) stochastically rounds the
+    master->shadow cast; on this per-step path the noise stream is a
+    fixed function of the seed — every call reuses the same draws
+    (unbiased per cast, but not independent across steps; the superstep
+    factories fold the step index in, use those for real SR training).
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
     init, train_step, eval_step, train_step_full = _raw_step_bodies(
-        model, optimizer, loss
+        model, optimizer, loss, precision
     )
+    sr_rng = (
+        jax.random.PRNGKey(sr_seed)
+        if precision == "bf16" and sr_seed is not None
+        else None
+    )
+    if sr_rng is not None and not health:
+        _plain_step = train_step
+
+        def train_step(params, opt_state, supports, x, y, mask, n_real=None):
+            return _plain_step(
+                params, opt_state, supports, x, y, mask, n_real, sr_rng
+            )
     if health:
         def train_step(params, opt_state, supports, x, y, mask, n_real=None):
             params, opt_state, loss_val, grads, updates, prev = train_step_full(
-                params, opt_state, supports, x, y, mask, n_real
+                params, opt_state, supports, x, y, mask, n_real, sr_rng
             )
             return params, opt_state, loss_val, _health_stats(
                 prev, grads, updates, loss_val
@@ -484,6 +589,8 @@ def make_superstep_fns(
     loss: str = "mse",
     checks: str | None = None,
     health: bool = False,
+    precision: str = "fp32",
+    sr_seed: Optional[int] = None,
 ) -> SuperstepFns:
     """Fuse S train steps into one jitted ``lax.scan`` over microbatches.
 
@@ -519,32 +626,50 @@ def make_superstep_fns(
     dispatches. The params/loss math is the same shared body, so the
     health program is bit-identical to the plain one; health *off*
     builds exactly today's program (the jaxpr budget pins this).
+
+    ``precision="bf16"`` scans the mixed-precision body (f32 master
+    params ride the carry, bf16 shadows regenerate per step — see
+    :func:`_raw_step_bodies`); with ``sr_seed`` set, each scan step
+    folds its step index into the seed so the stochastic master->shadow
+    rounding draws fresh noise per step, deterministically per
+    ``(sr_seed, step index within the block)``.
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
-    _, train_step, _, train_step_full = _raw_step_bodies(model, optimizer, loss)
+    _, train_step, _, train_step_full = _raw_step_bodies(
+        model, optimizer, loss, precision
+    )
+    sr_on = precision == "bf16" and sr_seed is not None
 
     def train_superstep(params, opt_state, supports, x_all, y_all, idx_block, mask_block):
         def body(carry, step_inputs):
             params, opt_state = carry
-            idx, mask = step_inputs
+            if sr_on:
+                idx, mask, step_i = step_inputs
+                sr_rng = jax.random.fold_in(jax.random.PRNGKey(sr_seed), step_i)
+            else:
+                idx, mask = step_inputs
+                sr_rng = None
             x = jnp.take(x_all, idx, axis=0)
             y = jnp.take(y_all, idx, axis=0)
             if health:
                 params, opt_state, loss_val, grads, updates, prev = (
-                    train_step_full(params, opt_state, supports, x, y, mask)
+                    train_step_full(
+                        params, opt_state, supports, x, y, mask, None, sr_rng
+                    )
                 )
                 stats = _health_stats(prev, grads, updates, loss_val)
                 return (params, opt_state), (loss_val, stats)
             params, opt_state, loss_val = train_step(
-                params, opt_state, supports, x, y, mask
+                params, opt_state, supports, x, y, mask, None, sr_rng
             )
             return (params, opt_state), loss_val
 
-        (params, opt_state), ys = jax.lax.scan(
-            body, (params, opt_state), (idx_block, mask_block)
-        )
+        xs = (idx_block, mask_block)
+        if sr_on:
+            xs = xs + (jnp.arange(idx_block.shape[0]),)
+        (params, opt_state), ys = jax.lax.scan(body, (params, opt_state), xs)
         if health:
             losses, stats = ys
             return params, opt_state, losses, stats
@@ -577,6 +702,8 @@ def make_series_superstep_fns(
     horizon: int = 1,
     checks: str | None = None,
     health: bool = False,
+    precision: str = "fp32",
+    sr_seed: Optional[int] = None,
 ) -> SeriesSuperstepFns:
     """The superstep of :func:`make_superstep_fns` over window-free data.
 
@@ -592,33 +719,45 @@ def make_series_superstep_fns(
     wraps the whole program in checkify as in :func:`make_superstep_fns`;
     ``health=True`` adds the per-step :func:`_health_stats` scan ys
     (same semantics and bit-identity guarantees as there).
+    ``precision``/``sr_seed`` behave as in :func:`make_superstep_fns`.
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
-    _, train_step, _, train_step_full = _raw_step_bodies(model, optimizer, loss)
+    _, train_step, _, train_step_full = _raw_step_bodies(
+        model, optimizer, loss, precision
+    )
+    sr_on = precision == "bf16" and sr_seed is not None
 
     def train_superstep(
         params, opt_state, supports, series, targets, offsets, idx_block, mask_block
     ):
         def body(carry, step_inputs):
             params, opt_state = carry
-            idx, mask = step_inputs
+            if sr_on:
+                idx, mask, step_i = step_inputs
+                sr_rng = jax.random.fold_in(jax.random.PRNGKey(sr_seed), step_i)
+            else:
+                idx, mask = step_inputs
+                sr_rng = None
             x, y = gather_window_batch(series, targets, offsets, idx, horizon)
             if health:
                 params, opt_state, loss_val, grads, updates, prev = (
-                    train_step_full(params, opt_state, supports, x, y, mask)
+                    train_step_full(
+                        params, opt_state, supports, x, y, mask, None, sr_rng
+                    )
                 )
                 stats = _health_stats(prev, grads, updates, loss_val)
                 return (params, opt_state), (loss_val, stats)
             params, opt_state, loss_val = train_step(
-                params, opt_state, supports, x, y, mask
+                params, opt_state, supports, x, y, mask, None, sr_rng
             )
             return (params, opt_state), loss_val
 
-        (params, opt_state), ys = jax.lax.scan(
-            body, (params, opt_state), (idx_block, mask_block)
-        )
+        xs = (idx_block, mask_block)
+        if sr_on:
+            xs = xs + (jnp.arange(idx_block.shape[0]),)
+        (params, opt_state), ys = jax.lax.scan(body, (params, opt_state), xs)
         if health:
             losses, stats = ys
             return params, opt_state, losses, stats
@@ -656,6 +795,8 @@ def make_fleet_superstep_fns(
     horizon: int = 1,
     checks: str | None = None,
     health: bool = False,
+    precision: str = "fp32",
+    sr_seed: Optional[int] = None,
 ) -> FleetSuperstepFns:
     """The window-free superstep of :func:`make_series_superstep_fns`
     generalized to one fleet *shape class* of cities.
@@ -681,11 +822,16 @@ def make_fleet_superstep_fns(
     ``(S, n_members)`` one-hot scatter of each step's loss into its
     slot — summing it over both axes reproduces the summed fleet loss
     exactly, and per-slot columns attribute it city by city.
+
+    ``precision``/``sr_seed`` behave as in :func:`make_superstep_fns`.
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
 
-    _, train_step, _, train_step_full = _raw_step_bodies(model, optimizer, loss)
+    _, train_step, _, train_step_full = _raw_step_bodies(
+        model, optimizer, loss, precision
+    )
+    sr_on = precision == "bf16" and sr_seed is not None
 
     def train_superstep(
         params, opt_state, supports_stack, series, targets, offsets,
@@ -693,7 +839,12 @@ def make_fleet_superstep_fns(
     ):
         def body(carry, step_inputs):
             params, opt_state = carry
-            idx, mask, slot, n_real = step_inputs
+            if sr_on:
+                idx, mask, slot, n_real, step_i = step_inputs
+                sr_rng = jax.random.fold_in(jax.random.PRNGKey(sr_seed), step_i)
+            else:
+                idx, mask, slot, n_real = step_inputs
+                sr_rng = None
             # leaf-wise slot select: for the dense (n_members, M, K, N, N)
             # stack this is exactly the old jnp.take; a pytree support
             # representation (e.g. a tiled-supports class stack) rides the
@@ -706,7 +857,7 @@ def make_fleet_superstep_fns(
             if health:
                 params, opt_state, loss_val, grads, updates, prev = (
                     train_step_full(
-                        params, opt_state, supports, x, y, mask, n_real
+                        params, opt_state, supports, x, y, mask, n_real, sr_rng
                     )
                 )
                 stats = _health_stats(prev, grads, updates, loss_val)
@@ -717,13 +868,14 @@ def make_fleet_superstep_fns(
                 )
                 return (params, opt_state), (loss_val, stats)
             params, opt_state, loss_val = train_step(
-                params, opt_state, supports, x, y, mask, n_real
+                params, opt_state, supports, x, y, mask, n_real, sr_rng
             )
             return (params, opt_state), loss_val
 
-        (params, opt_state), ys = jax.lax.scan(
-            body, (params, opt_state), (idx_block, mask_block, slot_block, n_real_block)
-        )
+        xs = (idx_block, mask_block, slot_block, n_real_block)
+        if sr_on:
+            xs = xs + (jnp.arange(idx_block.shape[0]),)
+        (params, opt_state), ys = jax.lax.scan(body, (params, opt_state), xs)
         if health:
             losses, stats = ys
             return params, opt_state, losses, stats

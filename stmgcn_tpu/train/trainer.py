@@ -81,6 +81,7 @@ from stmgcn_tpu.train.checkpoint import (
 from stmgcn_tpu.train.metrics import regression_report
 from stmgcn_tpu.utils.profiling import fence
 from stmgcn_tpu.train.step import (
+    PRECISIONS,
     StepFns,
     gather_window_batch,
     health_group_names,
@@ -192,6 +193,8 @@ class Trainer:
         grad_clip_norm: Optional[float] = None,
         loss: str = "mse",
         checks: Optional[str] = None,
+        precision: str = "fp32",
+        sr_seed: Optional[int] = None,
         n_epochs: int = 100,
         batch_size: int = 32,
         patience: int = 10,
@@ -225,6 +228,21 @@ class Trainer:
     ):
         self.model = model
         self.dataset = dataset
+        if precision not in PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {PRECISIONS}, got {precision!r}"
+            )
+        if sr_seed is not None and precision != "bf16":
+            raise ValueError(
+                "sr_seed (stochastic rounding) requires precision='bf16'"
+            )
+        #: step-program compute precision: "fp32" is bit-identical to the
+        #: pre-mixed-precision programs; "bf16" runs the lint-certified
+        #: mixed-precision twins. Either way the params the Trainer owns,
+        #: the optimizer state, and every checkpoint payload are f32
+        #: masters — precision never changes the checkpoint format.
+        self.precision = precision
+        self.sr_seed = sr_seed
         self.n_epochs = n_epochs
         self.batch_size = batch_size
         self.patience = patience
@@ -458,7 +476,8 @@ class Trainer:
 
         def _fresh_fns(mdl, health: bool = False):
             return make_step_fns(
-                mdl, self._optimizer, loss, checks=checks, health=health
+                mdl, self._optimizer, loss, checks=checks, health=health,
+                precision=precision, sr_seed=sr_seed,
             )
 
         self._make_fns = _fresh_fns
@@ -473,10 +492,12 @@ class Trainer:
             make_series_superstep_fns(
                 model, self._optimizer, loss,
                 horizon=self._horizon, checks=checks, health=health,
+                precision=precision, sr_seed=sr_seed,
             )
             if self._window_free
             else make_superstep_fns(
-                model, self._optimizer, loss, checks=checks, health=health
+                model, self._optimizer, loss, checks=checks, health=health,
+                precision=precision, sr_seed=sr_seed,
             )
         )
         self._superstep_fns = None
@@ -517,6 +538,7 @@ class Trainer:
         self._make_fleet_fns = lambda health=False: make_fleet_superstep_fns(
             model, self._optimizer, loss, horizon=self._horizon,
             checks=checks, health=health,
+            precision=precision, sr_seed=sr_seed,
         )
         if fleet_max_classes < 1:
             raise ValueError(f"fleet_max_classes must be >= 1, got {fleet_max_classes}")
@@ -850,7 +872,12 @@ class Trainer:
             # these pin it so resume refuses a mismatched data order
             "shuffle": self.shuffle,
             "steps_per_superstep": self.steps_per_superstep,
+            # provenance only: payloads are f32 masters at any precision,
+            # so bf16 runs restore into fp32 runs and vice versa
+            "precision": self.precision,
         }
+        if self.sr_seed is not None:
+            meta["sr_seed"] = self.sr_seed
         if self._lr_scale != 1.0:
             meta["lr_scale"] = self._lr_scale
         if self._batch_in_epoch:

@@ -2471,8 +2471,10 @@ class TestLintGateScript:
         assert payload["spmd"]["collectives"] > 0
         assert payload["spmd"]["findings"] == 0
         # the precision dataflow section: every registered contract
-        # program dtype-walked, sites classified, zero policy findings
+        # program dtype-walked — including the bf16 mixed-precision
+        # twins — sites classified, zero policy findings
         assert payload["precision"]["exit"] == 0
         assert payload["precision"]["programs"] > 0
+        assert payload["precision"]["bf16_programs"] > 0
         assert payload["precision"]["sites"] > 0
         assert payload["precision"]["findings"] == 0

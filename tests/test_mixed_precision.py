@@ -1,0 +1,422 @@
+"""Mixed-precision bf16 training: twin drills, prepared backward,
+master-param checkpoints, SR determinism, and config plumbing.
+
+The bf16 mode's contract is layered: (1) the *twin drill* — the bf16
+superstep twin of the fp32 program trains with health instrumentation
+on, every loss inside a pinned band of the fp32 run's, zero nonfinite
+grads or losses, and the optimizer-visible params stay f32 masters
+throughout; (2) the tiled Chebyshev apply's *prepared backward* (a
+custom VJP running the offline pre-transposed gathered-tiles SpMM over
+the cotangent) is parity-tested against both plain autodiff and the
+dense oracle, with a strictly smaller, scatter-free backward jaxpr; (3)
+checkpoints are precision-invariant — f32 masters in the same v2
+format, restore-compatible across ``--precision``, exact mid-epoch
+resume at bf16; (4) stochastic rounding is a pure function of
+``sr_seed``; (5) ``--precision`` rides the CLI -> ExperimentConfig ->
+json round trip, and the fp32 default traces programs containing no
+bf16 dtype at all (bit-identity with the pre-mixed-precision release is
+pinned structurally by the unchanged fp32 ``PRIMITIVE_BUDGETS`` and
+``PRECISION_BASELINES``).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stmgcn_tpu.cli import build_parser, config_from_args
+from stmgcn_tpu.config import ExperimentConfig, TrainConfig, preset
+from stmgcn_tpu.data import DemandDataset, WindowSpec, grid_adjacency, synthetic_dataset
+from stmgcn_tpu.models import STMGCN
+from stmgcn_tpu.ops import SupportConfig
+from stmgcn_tpu.ops.tiling import (
+    gathered_tiles_apply,
+    gathered_tiles_apply_reference,
+    plan_tiling,
+)
+from stmgcn_tpu.resilience import FaultPlan, FaultSpec, InjectedFault
+from stmgcn_tpu.train import (
+    Trainer,
+    make_optimizer,
+    make_step_fns,
+    make_superstep_fns,
+    verify_checkpoint,
+)
+from stmgcn_tpu.train.step import PRECISIONS, _health_stats
+
+
+def same(a, b):
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+def _leaf_dtypes(tree):
+    return {str(leaf.dtype) for leaf in jax.tree.leaves(tree)}
+
+
+# ---------------------------------------------------------------------------
+# shared unit fixture: the test_superstep.py shapes, pool large enough for
+# a 6-step block so the twin drill sees several optimizer steps
+
+
+def _drill_fixture():
+    rng = np.random.default_rng(0)
+    m, n, t, b, s, pool = 2, 9, 5, 4, 6, 12
+    sup = jnp.asarray(rng.standard_normal((m, 3, n, n)).astype(np.float32) * 0.2)
+    model = STMGCN(m_graphs=m, n_supports=3, seq_len=t, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8)
+    x_all = jnp.asarray(rng.standard_normal((pool, t, n, 1)).astype(np.float32))
+    y_all = jnp.asarray(
+        rng.standard_normal((pool, n, 1)).astype(np.float32) * 0.1
+    )
+    opt = make_optimizer(1e-3, 1e-4)
+    fns = make_step_fns(model, opt, "mse")
+    params, opt_state = fns.init(jax.random.key(0), sup, x_all[:b])
+    idx = jnp.asarray(rng.integers(0, pool, size=(s, b)).astype(np.int32))
+    mask = jnp.ones((s, b), jnp.float32)
+    return model, opt, sup, x_all, y_all, params, opt_state, idx, mask
+
+
+class TestTwinDrill:
+    """bf16 superstep vs its fp32 twin, health instrumentation on."""
+
+    def test_bf16_superstep_drill_pinned_band_zero_nonfinite(self):
+        model, opt, sup, x_all, y_all, params, opt_state, idx, mask = (
+            _drill_fixture()
+        )
+        runs = {}
+        for p in PRECISIONS:
+            sfns = make_superstep_fns(model, opt, "mse", health=True,
+                                      precision=p)
+            # both paths donate (params, opt_state): hand each its own copy
+            pp = jax.tree.map(jnp.copy, params)
+            ss = jax.tree.map(jnp.copy, opt_state)
+            pp, ss, losses, stats = sfns.train_superstep(
+                pp, ss, sup, x_all, y_all, idx, mask
+            )
+            runs[p] = (np.asarray(losses), stats, pp)
+
+        losses32, stats32, _ = runs["fp32"]
+        losses16, stats16, params16 = runs["bf16"]
+        # zero nonfinite anywhere in the bf16 drill — grads and losses
+        for stats in (stats32, stats16):
+            assert int(np.sum(np.asarray(stats["nonfinite_grads"]))) == 0
+            assert int(np.sum(np.asarray(stats["nonfinite_loss"]))) == 0
+        assert np.isfinite(losses16).all()
+        # the pinned band: bf16 per-step losses track fp32 to well under
+        # a loss-unit of drift at these shapes (measured ~6e-6; the band
+        # leaves headroom for BLAS variation without admitting a broken
+        # accumulation island, which drifts orders of magnitude further)
+        np.testing.assert_allclose(losses16, losses32, rtol=0, atol=1e-3)
+        assert np.abs(losses16 - losses32).max() < 1e-3
+        # the optimizer-visible state never leaves f32: masters, not shadows
+        assert _leaf_dtypes(params16) == {"float32"}
+        # grad-norm health math is f32 even when grads originate bf16-side
+        assert stats16["grad_norm"].dtype == jnp.float32
+
+    def test_precision_validation(self):
+        model, opt, *_ = _drill_fixture()
+        with pytest.raises(ValueError, match="precision"):
+            make_step_fns(model, opt, "mse", precision="fp16")
+        assert PRECISIONS == ("fp32", "bf16")
+
+
+class TestHealthStatsBf16:
+    """The _health_stats fix: norm math in f32 on bf16 grad trees."""
+
+    def test_grad_norm_f32_on_bf16_grads(self):
+        # 1 + 2^-7 is exactly representable in bf16 (7 mantissa bits),
+        # so the fixture loses nothing entering the tree; the norm and
+        # update_ratio must come back as f32 scalars matching the
+        # float64 reference far inside bf16's ~4e-3 resolution
+        v = 1.0 + 2.0 ** -7
+        big = jnp.full((1024,), v, jnp.bfloat16)
+        grads = {"params": {"lstm": {"w": big}}}
+        params = {"params": {"lstm": {"w": jnp.ones((1024,), jnp.bfloat16)}}}
+        stats = _health_stats(params, grads, grads, jnp.float32(0.5))
+        assert stats["grad_norm"].dtype == jnp.float32
+        assert stats["update_ratio"].dtype == jnp.float32
+        assert stats["group_norms"].dtype == jnp.float32
+        want = float(np.sqrt(np.sum(np.full(1024, v, np.float64) ** 2)))
+        np.testing.assert_allclose(float(stats["grad_norm"]), want, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(stats["update_ratio"]), want / 32.0, rtol=1e-5
+        )
+        # nonfinite counting stays on the RAW leaves: a genuinely inf
+        # bf16 grad is counted, a merely-large finite one is not
+        assert int(stats["nonfinite_grads"]) == 0
+        grads_inf = {"params": {"lstm": {"w": big.at[0].set(jnp.inf)}}}
+        stats = _health_stats(params, grads_inf, grads, jnp.float32(0.5))
+        assert int(stats["nonfinite_grads"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# prepared backward
+
+
+def _tiled_fixture(tile=8):
+    rng = np.random.default_rng(0)
+    side, m_graphs = 8, 3
+    n = side * side
+    shuffle = rng.permutation(n)
+    adjs = []
+    for _ in range(m_graphs):
+        a = grid_adjacency(side)
+        extra = (rng.random((n, n)) < 0.01).astype(np.float32)
+        a = np.maximum(a, np.maximum(extra, extra.T))
+        np.fill_diagonal(a, 0)
+        adjs.append(a[shuffle][:, shuffle])
+    dense = SupportConfig("chebyshev", 2).build_all(adjs)
+    return dense, plan_tiling(dense, tile=tile)
+
+
+def _count_primitives(closed):
+    """Total eqn count, recursing through pjit/scan/custom-vjp bodies."""
+    total = 0
+
+    def walk(jaxpr):
+        nonlocal total
+        for eqn in jaxpr.eqns:
+            total += 1
+            for p in eqn.params.values():
+                subs = p if isinstance(p, (list, tuple)) else (p,)
+                for q in subs:
+                    sub = getattr(q, "jaxpr", None)
+                    if sub is not None:
+                        walk(getattr(sub, "jaxpr", sub))
+
+    walk(closed.jaxpr)
+    return total
+
+
+class TestPreparedBackward:
+    def test_vjp_parity_tiled_and_dense(self):
+        dense, plan = _tiled_fixture()
+        n = dense.shape[-1]
+        x = jnp.asarray(
+            np.random.default_rng(4).standard_normal((n, 6)).astype(np.float32)
+        )
+        perm = np.asarray(plan.perm)
+        for m in range(dense.shape[0]):
+            br = plan[m]
+            g_prep = jax.grad(
+                lambda xx: (gathered_tiles_apply(br, xx) ** 2).sum()
+            )(x)
+            g_auto = jax.grad(
+                lambda xx: (gathered_tiles_apply_reference(br, xx) ** 2).sum()
+            )(x)
+            # dense oracle on the same permuted coordinates
+            permuted = jnp.asarray(dense[m][:, perm][:, :, perm])
+            g_dense = jax.grad(
+                lambda xx: (
+                    jnp.einsum("kij,jf->kif", permuted, xx) ** 2
+                ).sum()
+            )(x)
+            np.testing.assert_allclose(np.asarray(g_prep), np.asarray(g_auto),
+                                       rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(np.asarray(g_prep), np.asarray(g_dense),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_backward_jaxpr_smaller_and_scatter_free(self):
+        _, plan = _tiled_fixture()
+        br = plan[0]
+        x = jax.ShapeDtypeStruct((br.n, 6), jnp.float32)
+        prep = jax.make_jaxpr(
+            jax.grad(lambda xx: (gathered_tiles_apply(br, xx) ** 2).sum())
+        )(x)
+        auto = jax.make_jaxpr(
+            jax.grad(
+                lambda xx: (gathered_tiles_apply_reference(br, xx) ** 2).sum()
+            )
+        )(x)
+        n_prep, n_auto = _count_primitives(prep), _count_primitives(auto)
+        # strictly below autodiff, and pinned: regressions that re-grow
+        # the backward (a scatter sneaking back in, a lost fusion) move
+        # this number
+        assert n_prep < n_auto
+        assert n_prep == 24
+        # the autodiff transpose scatters cotangent tiles back through
+        # the gather; the prepared backward is a second gathered SpMM
+        assert "scatter" in str(auto.jaxpr)
+        assert "scatter" not in str(prep.jaxpr)
+
+    def test_prepared_backward_under_bf16_inputs_accumulates_f32(self):
+        _, plan = _tiled_fixture()
+        br = plan[0]
+        x16 = jnp.asarray(
+            np.random.default_rng(5)
+            .standard_normal((br.n, 6))
+            .astype(np.float32)
+        ).astype(jnp.bfloat16)
+        g = jax.grad(
+            lambda xx: (gathered_tiles_apply(br, xx) ** 2)
+            .sum(dtype=jnp.float32)
+        )(x16)
+        # cotangent returns in the primal's dtype, accumulated f32 inside
+        assert g.dtype == jnp.bfloat16
+        assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+# ---------------------------------------------------------------------------
+# checkpoints: f32 masters, precision-invariant format, mid-epoch resume
+
+
+def _build_trainer(out_dir, precision="fp32", epochs=2, **kw):
+    data = synthetic_dataset(rows=3, n_timesteps=24 * 7 * 2 + 60, seed=1)
+    dataset = DemandDataset(data, WindowSpec(3, 1, 1, 24))
+    sup = SupportConfig("chebyshev", 2).build_all(dataset.adjs.values())
+    model = STMGCN(m_graphs=3, n_supports=3, seq_len=5, input_dim=1,
+                   lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8)
+    return Trainer(model, dataset, sup, n_epochs=epochs, batch_size=16,
+                   data_placement="resident", out_dir=str(out_dir),
+                   precision=precision, verbose=False, **kw)
+
+
+class TestMasterCheckpoints:
+    def test_bf16_checkpoint_roundtrip_f32_masters(self, tmp_path):
+        tr = _build_trainer(tmp_path / "run", precision="bf16", epochs=1)
+        tr.train()
+        tr.flush_checkpoints()
+        meta = verify_checkpoint(str(tmp_path / "run" / "latest.ckpt"))
+        assert meta["precision"] == "bf16"
+        fresh = _build_trainer(tmp_path / "run", precision="bf16", epochs=1)
+        restored = fresh.restore()
+        assert restored["precision"] == "bf16"
+        # the payload is the f32 masters — bit for bit, no bf16 leaves
+        assert _leaf_dtypes(fresh.params) == {"float32"}
+        same(fresh.params, tr.params)
+        same(jax.tree.leaves(fresh.opt_state), jax.tree.leaves(tr.opt_state))
+
+    def test_restore_compatible_across_precisions(self, tmp_path):
+        """fp32 checkpoints load into bf16 trainers and vice versa —
+        precision is provenance in meta, never a format change."""
+        tr32 = _build_trainer(tmp_path / "a", precision="fp32", epochs=1)
+        tr32.train()
+        tr32.flush_checkpoints()
+        tr16 = _build_trainer(tmp_path / "a", precision="bf16", epochs=1)
+        meta = tr16.restore()
+        assert meta["precision"] == "fp32"  # the *writer's* provenance
+        same(tr16.params, tr32.params)
+
+    @pytest.mark.slow
+    def test_mid_epoch_resume_bit_exact_at_bf16(self, tmp_path):
+        """The resilience drill at bf16: crash mid-epoch with a step-
+        cadence checkpoint, resume, end bit-identical to uninterrupted."""
+        ref = _build_trainer(tmp_path / "ref", precision="bf16")
+        ref.train()
+
+        plan = FaultPlan(FaultSpec("raise", epoch=2, step=3))
+        faulted = _build_trainer(tmp_path / "run", precision="bf16",
+                                 fault_plan=plan, checkpoint_every_steps=1)
+        with pytest.raises(InjectedFault):
+            faulted.train()
+        faulted.flush_checkpoints()
+        meta = verify_checkpoint(str(tmp_path / "run" / "latest.ckpt"))
+        assert meta["precision"] == "bf16"
+        assert meta["epoch"] == 2 and meta["batch_in_epoch"] == 3
+
+        resumed = _build_trainer(tmp_path / "run", precision="bf16",
+                                 checkpoint_every_steps=1)
+        assert resumed.restore_auto() is not None
+        resumed.train()
+        same(ref.params, resumed.params)
+        same(jax.tree.leaves(ref.opt_state),
+             jax.tree.leaves(resumed.opt_state))
+
+    def test_trainer_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="precision"):
+            _build_trainer(tmp_path, precision="fp16")
+        with pytest.raises(ValueError, match="sr_seed"):
+            _build_trainer(tmp_path, precision="fp32", sr_seed=7)
+
+
+class TestStochasticRounding:
+    def test_sr_deterministic_per_seed(self):
+        model, opt, sup, x_all, y_all, params, opt_state, idx, mask = (
+            _drill_fixture()
+        )
+        b = idx.shape[1]
+        x, y = x_all[:b], y_all[:b]
+        m1 = jnp.ones((b,), jnp.float32)
+
+        def run(seed):
+            fns = make_step_fns(model, opt, "mse", precision="bf16",
+                                sr_seed=seed)
+            pp = jax.tree.map(jnp.copy, params)
+            ss = jax.tree.map(jnp.copy, opt_state)
+            pp, ss, loss = fns.train_step(pp, ss, sup, x, y, m1)
+            return pp, float(loss)
+
+        p_a, l_a = run(7)
+        p_b, l_b = run(7)
+        p_c, l_c = run(11)
+        same(p_a, p_b)
+        assert l_a == l_b
+        # a different seed draws different rounding noise
+        assert l_a != l_c
+        leaves_a, leaves_c = jax.tree.leaves(p_a), jax.tree.leaves(p_c)
+        assert any(
+            not np.array_equal(np.asarray(x1), np.asarray(x2))
+            for x1, x2 in zip(leaves_a, leaves_c)
+        )
+        # SR perturbs the cast, not the scale: still finite, still close
+        assert abs(l_a - l_c) < 1e-2
+        assert _leaf_dtypes(p_a) == {"float32"}
+
+    def test_sr_requires_bf16(self):
+        model, opt, *_ = _drill_fixture()
+        # fp32 + sr_seed is inert at the factory level (sr applies only
+        # to the bf16 cast); the *trainer* rejects it loudly instead —
+        # TestMasterCheckpoints.test_trainer_validation pins that.
+        fns = make_step_fns(model, opt, "mse", precision="fp32", sr_seed=3)
+        assert fns.train_step is not None
+
+
+# ---------------------------------------------------------------------------
+# config plumbing: CLI -> ExperimentConfig -> json round trip (tier 1)
+
+
+class TestPrecisionConfigPlumbing:
+    def test_cli_round_trip(self):
+        args = build_parser().parse_args(
+            ["--preset", "smoke", "--precision", "bf16", "--sr-seed", "7"]
+        )
+        cfg = config_from_args(args)
+        assert cfg.train.precision == "bf16"
+        assert cfg.train.sr_seed == 7
+        thawed = ExperimentConfig.from_dict(
+            json.loads(json.dumps(cfg.to_dict()))
+        )
+        assert thawed.train.precision == "bf16"
+        assert thawed.train.sr_seed == 7
+
+    def test_fp32_default_everywhere(self):
+        assert TrainConfig().precision == "fp32"
+        assert TrainConfig().sr_seed is None
+        args = build_parser().parse_args(["--preset", "smoke"])
+        cfg = config_from_args(args)
+        assert cfg.train.precision == "fp32" and cfg.train.sr_seed is None
+        thawed = ExperimentConfig.from_dict(
+            json.loads(json.dumps(preset("smoke").to_dict()))
+        )
+        assert thawed.train.precision == "fp32"
+
+    def test_fp32_programs_contain_no_bf16(self):
+        """The structural half of the bit-identity claim: every fp32
+        contract program's dtype census is bf16-free (the byte-level
+        half is the unchanged fp32 PRIMITIVE_BUDGETS / baselines, pinned
+        by test_analysis / test_precision)."""
+        from stmgcn_tpu.analysis.dtype_flow import program_flows
+        from stmgcn_tpu.analysis.precision_check import precision_summary
+
+        flows = program_flows("smoke")
+        bf16_twins = {n for n in flows if n.endswith("_bf16")}
+        assert len(bf16_twins) == 4
+        for name, flow in flows.items():
+            kinds = set(flow.census["bytes"]) | set(flow.census["flops"])
+            if name in bf16_twins:
+                assert "bfloat16" in kinds
+            else:
+                assert "bfloat16" not in kinds, name
+        assert precision_summary("smoke")["bf16_programs"] == 4

@@ -15,6 +15,9 @@
 #    "findings": N|null},
 #    "continual": {"exit": N, "promotions": N|null, "rejections": N|null,
 #    "nonfinite": N|null},
+#    "federation": {"exit": N, "hung": N|null, "cross_generation": N|null,
+#    "kills": N|null, "recovered": N|null, "cities": N|null,
+#    "findings": N|null},
 #    "spmd": {"exit": N, "programs": N|null, "collectives": N|null,
 #    "findings": N|null},
 #    "precision": {"exit": N, "programs": N|null, "bf16_programs": N|null,
@@ -154,6 +157,34 @@ EOF
 continual_exit=$?
 printf '%s\n' "$continual_json" >&2
 
+# Federation kill-and-recover drill: a short M=2 tier soak over real
+# engines runs the full fault schedule (poisoned candidate, replica
+# kill, herd spike, hang-on-drain) open-loop. The gate fails on any
+# hung caller, any cross-generation response, a kill drill that never
+# fired, cities left unserveable after recovery, or federation-config
+# contract findings on the shipped presets.
+federation_json=$(JAX_PLATFORMS=cpu "$PY" - <<'EOF' 2>>/dev/stderr
+import json
+
+from stmgcn_tpu.analysis.federation_check import check_federation_config
+from stmgcn_tpu.serving.bench import run_federation_soak, train_throwaway
+
+fc, supports = train_throwaway(rows=3, epochs=1)
+rec = run_federation_soak(fc, supports, replicas=2, soak_seconds=0.4,
+                          buckets=(1, 2, 4))
+print(json.dumps({
+    "hung": rec["soak"]["hung_clients"],
+    "cross_generation": rec["soak"]["cross_generation"],
+    "kills": rec["router"]["kills"],
+    "recovered": rec["recovery"]["cities_serveable"],
+    "cities": rec["recovery"]["cities_total"],
+    "findings": len(check_federation_config()),
+}))
+EOF
+)
+federation_exit=$?
+printf '%s\n' "$federation_json" >&2
+
 # SPMD contract evidence: the pass must have lowered every probe program
 # (zero programs means the probes silently stopped building — the same
 # empty-database failure mode the concurrency section guards against)
@@ -197,6 +228,7 @@ CONC_JSON="$conc_json" CONC_EXIT="$conc_exit" \
 RUFF_AVAILABLE="$ruff_available" RUFF_EXIT="$ruff_exit" \
 OBS_JSON="$obs_json" OBS_EXIT="$obs_exit" \
 CONTINUAL_JSON="$continual_json" CONTINUAL_EXIT="$continual_exit" \
+FEDERATION_JSON="$federation_json" FEDERATION_EXIT="$federation_exit" \
 SPMD_JSON="$spmd_json" SPMD_EXIT="$spmd_exit" \
 PRECISION_JSON="$precision_json" PRECISION_EXIT="$precision_exit" \
 "$PY" - <<'EOF'
@@ -227,6 +259,11 @@ try:
 except ValueError:
     continual = {}
 continual_exit = int(os.environ["CONTINUAL_EXIT"])
+try:
+    federation = json.loads(os.environ["FEDERATION_JSON"])
+except ValueError:
+    federation = {}
+federation_exit = int(os.environ["FEDERATION_EXIT"])
 try:
     spmd = json.loads(os.environ["SPMD_JSON"])
 except ValueError:
@@ -259,6 +296,17 @@ ok = ok and continual_exit == 0
 ok = ok and continual.get("promotions") == 1
 ok = ok and continual.get("rejections") == 1
 ok = ok and continual.get("nonfinite") == 0
+# federation drill: no caller hung, no mixed-generation response left
+# the router, the scheduled replica kill actually fired, every city is
+# serveable again after the drills, and the shipped presets pass the
+# federation-config topology contract
+ok = ok and federation_exit == 0
+ok = ok and federation.get("hung") == 0
+ok = ok and federation.get("cross_generation") == 0
+ok = ok and federation.get("kills") == 1
+ok = ok and federation.get("recovered") is not None
+ok = ok and federation.get("recovered") == federation.get("cities")
+ok = ok and federation.get("findings") == 0
 # spmd contract pass: every probe program lowered (zero programs means
 # the probes stopped building) with zero collective-manifest/wire/
 # footprint findings
@@ -305,6 +353,15 @@ print(json.dumps({
         "promotions": continual.get("promotions"),
         "rejections": continual.get("rejections"),
         "nonfinite": continual.get("nonfinite"),
+    },
+    "federation": {
+        "exit": federation_exit,
+        "hung": federation.get("hung"),
+        "cross_generation": federation.get("cross_generation"),
+        "kills": federation.get("kills"),
+        "recovered": federation.get("recovered"),
+        "cities": federation.get("cities"),
+        "findings": federation.get("findings"),
     },
     "spmd": {
         "exit": spmd_exit,

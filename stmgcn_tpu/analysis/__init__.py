@@ -46,7 +46,11 @@ by tier-1 (``tests/test_analysis.py``):
   waste bounded), observability budget math for every preset (span-ring
   and histogram-reservoir bounds, :mod:`.obs_check`), numeric-health
   config math for every preset (drift-without-baseline, sketch and
-  reservoir budgets, cadence, :mod:`.health_check`), and static Pallas
+  reservoir budgets, cadence, :mod:`.health_check`),
+  serving-federation topology math for every preset (replica vs city
+  counts, virtual-node count vs the imbalance bound, tier-wide
+  overload budget vs per-replica local bounds, drain vs handover
+  window ordering, :mod:`.federation_check`), and static Pallas
   kernel checks (:mod:`.pallas_check`):
   grid/BlockSpec divisibility plus a calibrated VMEM-footprint estimate
   for every ``pl.pallas_call`` site in :mod:`stmgcn_tpu.ops.pallas_lstm`
@@ -74,6 +78,7 @@ Suppress a finding with ``# stmgcn: ignore[rule-id]`` (or a bare
 from stmgcn_tpu.analysis.collective_check import check_collective_contracts
 from stmgcn_tpu.analysis.concurrency_check import check_concurrency
 from stmgcn_tpu.analysis.continual_check import check_continual_config
+from stmgcn_tpu.analysis.federation_check import check_federation_config
 from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
 from stmgcn_tpu.analysis.health_check import check_health_overhead
 from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
@@ -114,6 +119,7 @@ __all__ = [
     "check_collective_contracts",
     "check_concurrency",
     "check_continual_config",
+    "check_federation_config",
     "check_fleet_shape_classes",
     "check_health_overhead",
     "check_obs_overhead",

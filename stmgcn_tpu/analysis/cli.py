@@ -141,6 +141,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # lint must never queue on (or wake) an accelerator
         from stmgcn_tpu.analysis.collective_check import check_collective_contracts
         from stmgcn_tpu.analysis.continual_check import check_continual_config
+        from stmgcn_tpu.analysis.federation_check import check_federation_config
         from stmgcn_tpu.analysis.fleet_check import check_fleet_shape_classes
         from stmgcn_tpu.analysis.health_check import check_health_overhead
         from stmgcn_tpu.analysis.jaxpr_check import check_step_contracts
@@ -170,6 +171,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_obs_overhead())
         findings.extend(check_health_overhead())
         findings.extend(check_continual_config())
+        findings.extend(check_federation_config())
         findings.extend(check_tile_plan())
         # static Pallas checks ride the contract section: deriving the
         # kernel's real block sizes imports ops.pallas_lstm (jax), which

@@ -1,0 +1,66 @@
+"""Pass 2k: serving-federation contracts — tier topology config math.
+
+A federation misconfiguration does not fail a request, it degrades a
+tier: more replicas than cities leaves paid-for engines permanently
+idle behind the hash ring, too few virtual nodes makes the ring's
+imbalance exceed the bound the capacity plan assumed, a global overload
+budget below a single replica's local bound turns the *tier* limiter
+into the binding constraint (every replica sheds on the shared budget
+before its own queue fills — the local SLO math goes dead), and a
+handover window longer than the drain window means a re-shard can
+out-wait the drain that triggered it. The per-config arithmetic is
+``FederationConfig.violations()``; this pass evaluates it per preset
+with the cross-cutting inputs wired in: the sibling
+:class:`~stmgcn_tpu.config.ServingConfig` for the budget cross-check
+and the data config's city count for the topology check. Pure config
+math — no JAX, no engines.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from stmgcn_tpu.analysis.report import Finding
+from stmgcn_tpu.analysis.rules import RULES
+
+__all__ = ["check_federation_config"]
+
+
+def check_federation_config(
+    configs: Optional[Iterable[Tuple[str, object]]] = None,
+) -> List[Finding]:
+    """Validate every preset's federation topology knobs.
+
+    ``configs`` is ``(name, ExperimentConfig)`` pairs; default is every
+    registered preset. One finding per violation string.
+    """
+    from stmgcn_tpu.config import PRESETS
+
+    if configs is None:
+        configs = [(name, build()) for name, build in PRESETS.items()]
+
+    findings: List[Finding] = []
+
+    def emit(name: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="federation-config",
+                path=f"<contract:federation:{name}>",
+                line=0,
+                message=message,
+                severity=RULES["federation-config"].severity,
+            )
+        )
+
+    for name, cfg in configs:
+        fed = getattr(cfg, "federation", None)
+        if fed is None:
+            continue
+        data = getattr(cfg, "data", None)
+        n_cities = None if data is None else getattr(data, "n_cities", None)
+        for violation in fed.violations(
+            serving=getattr(cfg, "serving", None),
+            n_cities=n_cities,
+        ):
+            emit(name, f"{name}: {violation}")
+    return findings

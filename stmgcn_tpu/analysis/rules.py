@@ -203,6 +203,19 @@ _ALL_RULES = [
         "any step runs",
     ),
     Rule(
+        "federation-config",
+        "error",
+        "a preset's serving-federation topology cannot hold its own "
+        "contracts (more replicas than cities — engines permanently "
+        "idle behind the hash ring, too few virtual nodes for the "
+        "configured imbalance bound, a tier-wide overload budget below "
+        "a single replica's local queue bound or top dispatch rung — "
+        "the global limiter binds before any local SLO math applies, "
+        "or a handover window that out-waits the drain window) — "
+        "FederationConfig.violations() config math, detectable before "
+        "any replica is built",
+    ),
+    Rule(
         "pallas-blockspec",
         "error",
         "a pl.pallas_call BlockSpec/grid disagrees with its operand "

@@ -23,6 +23,7 @@ __all__ = [
     "ContinualConfig",
     "DataConfig",
     "ExperimentConfig",
+    "FederationConfig",
     "HealthConfig",
     "MeshConfig",
     "ModelConfig",
@@ -762,6 +763,120 @@ class ContinualConfig:
         return v
 
 
+@dataclasses.dataclass
+class FederationConfig:
+    """Multi-replica serving-tier knobs (:mod:`stmgcn_tpu.serving
+    .federation`).
+
+    Off by default — with ``enabled=False`` the serving path is exactly
+    the single-engine build. ``violations()`` is the pure-config
+    contract behind the ``federation-config`` lint rule: a tier with
+    more replicas than cities leaves replicas permanently idle, a hash
+    ring with too few points cannot meet its imbalance bound, a global
+    overload budget below a single replica's local bound sheds the tier
+    before any replica could fill, and a handover window longer than a
+    full drain inverts the lifecycle ordering — all deployment outages
+    detectable before any request is served.
+    """
+
+    #: run the federation router (a single-replica deployment never
+    #: builds a ring or a tier budget)
+    enabled: bool = False
+    #: active engine replicas the ring shards cities across
+    replicas: int = 3
+    #: warm spares kept built + checkpoint-watching but outside the ring
+    spares: int = 0
+    #: hash-ring points per replica (virtual nodes); more points =
+    #: smoother city distribution and smaller re-shard movement
+    vnodes: int = 64
+    #: bound on relative per-replica load imbalance the ring may exhibit
+    #: (max replica share vs the uniform share, as a fraction over 1.0)
+    imbalance_max: float = 0.5
+    #: tier-wide pending-row budget shared by every replica's admission
+    #: controller; 0 = no global budget (local bounds only)
+    global_queue_bound_rows: int = 0
+    #: drain: max seconds to wait for a replica's in-flight work to
+    #: flush before declaring it wedged and detaching anyway
+    drain_timeout_s: float = 5.0
+    #: re-shard: max seconds moved cities may wait for their old owner's
+    #: in-flight work during the handover window
+    handover_timeout_s: float = 2.0
+
+    def violations(self, *, serving=None, n_cities=None) -> list:
+        """Every way this config breaks the tier deployment contract
+        (empty list = valid; the ``federation-config`` rule). Ring
+        bounds always apply — a pre-built ring exists with the router
+        off; replica-vs-city, budget, and lifecycle checks only matter
+        once the tier is enabled. ``serving`` brings in the sibling
+        :class:`ServingConfig` for the budget cross-check; ``n_cities``
+        the data config's city count.
+        """
+        v = []
+        if self.vnodes < 1:
+            v.append(f"vnodes must be >= 1, got {self.vnodes}")
+        if not 0.0 < self.imbalance_max <= 1.0:
+            v.append(
+                f"imbalance_max must be in (0, 1], got {self.imbalance_max}"
+            )
+        elif self.vnodes >= 1 and self.replicas >= 1:
+            # ring imbalance shrinks ~ 1/sqrt(total points): demand
+            # enough points that the configured bound is plausible
+            need = int(4.0 / (self.imbalance_max * self.imbalance_max))
+            if self.replicas * self.vnodes < need:
+                v.append(
+                    f"hash ring has {self.replicas * self.vnodes} points "
+                    f"({self.replicas} replicas x {self.vnodes} vnodes) — "
+                    f"fewer than the {need} needed to bound imbalance at "
+                    f"{self.imbalance_max}; add vnodes or relax the bound"
+                )
+        if not self.enabled:
+            return v
+        if self.replicas < 1:
+            v.append(f"replicas must be >= 1, got {self.replicas}")
+        if self.spares < 0:
+            v.append(f"spares must be >= 0, got {self.spares}")
+        if n_cities is not None and self.replicas > n_cities:
+            v.append(
+                f"{self.replicas} replicas for {n_cities} cities — "
+                "city->replica sharding leaves at least one replica "
+                "permanently idle; shrink the tier or add cities"
+            )
+        if self.global_queue_bound_rows < 0:
+            v.append(
+                f"global_queue_bound_rows must be >= 0, got "
+                f"{self.global_queue_bound_rows}"
+            )
+        elif self.global_queue_bound_rows and serving is not None:
+            local = int(serving.queue_bound_rows)
+            if local and self.global_queue_bound_rows < local:
+                v.append(
+                    f"global_queue_bound_rows {self.global_queue_bound_rows} "
+                    f"is below the per-replica bound {local} — the tier "
+                    "budget would shed before any single replica's queue "
+                    "could legally fill"
+                )
+            top = serving.buckets[-1] if serving.buckets else 0
+            if top and self.global_queue_bound_rows < top:
+                v.append(
+                    f"global_queue_bound_rows {self.global_queue_bound_rows} "
+                    f"is below the top ladder rung {top} — no saturated "
+                    "dispatch could ever be admitted tier-wide"
+                )
+        if self.drain_timeout_s <= 0 or self.handover_timeout_s <= 0:
+            v.append(
+                f"lifecycle timeouts must be positive, got drain="
+                f"{self.drain_timeout_s}, handover={self.handover_timeout_s}"
+            )
+        elif self.handover_timeout_s > self.drain_timeout_s:
+            v.append(
+                f"handover_timeout_s {self.handover_timeout_s} exceeds "
+                f"drain_timeout_s {self.drain_timeout_s} — a re-shard "
+                "handover flushes a subset of one replica's in-flight "
+                "work and can never be allowed longer than a full drain"
+            )
+        return v
+
+
 #: float dtype names the precision policy can legislate over
 PRECISION_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
 
@@ -944,6 +1059,7 @@ class ExperimentConfig:
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
     continual: ContinualConfig = dataclasses.field(default_factory=ContinualConfig)
+    federation: FederationConfig = dataclasses.field(default_factory=FederationConfig)
     precision: PrecisionPolicy = dataclasses.field(default_factory=PrecisionPolicy)
 
     def to_dict(self) -> dict:
@@ -961,6 +1077,7 @@ class ExperimentConfig:
             obs=ObsConfig(**d.get("obs", {})),
             health=HealthConfig(**d.get("health", {})),
             continual=ContinualConfig(**d.get("continual", {})),
+            federation=FederationConfig(**d.get("federation", {})),
             precision=PrecisionPolicy(**d.get("precision", {})),
         )
 

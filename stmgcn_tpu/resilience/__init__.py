@@ -28,6 +28,12 @@ tested paths:
   mirror for the continual loop: a deterministic stream transformer
   (gap / out-of-order / duplicate / nonfinite / SIGTERM by source-row
   ordinal) applied before rows reach the device-resident ingest ring.
+- :class:`FederationFaultPlan` / :class:`FederationFaultSpec` — the
+  tier-level mirror for the serving federation: replica kill by scatter
+  ordinal, hang-on-drain, thundering-herd city spikes, and at-rest
+  candidate poisoning before the tier promotion gate, so the
+  kill/re-shard/herd/rejection drills of ``serve-bench --federation``
+  are deterministic too.
 
 The verified-checkpoint side (CRC32 format v2, ``load_latest_verified``
 recovery chain) lives in :mod:`stmgcn_tpu.train.checkpoint`.
@@ -37,6 +43,8 @@ from stmgcn_tpu.resilience.faults import (
     BatcherKilled,
     FaultPlan,
     FaultSpec,
+    FederationFaultPlan,
+    FederationFaultSpec,
     IngestFaultPlan,
     IngestFaultSpec,
     InjectedFault,
@@ -52,6 +60,8 @@ __all__ = [
     "DivergenceGuard",
     "FaultPlan",
     "FaultSpec",
+    "FederationFaultPlan",
+    "FederationFaultSpec",
     "IngestFaultPlan",
     "IngestFaultSpec",
     "InjectedFault",

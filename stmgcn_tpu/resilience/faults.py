@@ -57,8 +57,11 @@ from stmgcn_tpu.obs.registry import REGISTRY
 
 __all__ = [
     "BatcherKilled",
+    "FEDERATION_KINDS",
     "FaultPlan",
     "FaultSpec",
+    "FederationFaultPlan",
+    "FederationFaultSpec",
     "INGEST_KINDS",
     "IngestFaultPlan",
     "IngestFaultSpec",
@@ -81,6 +84,12 @@ SERVE_KINDS = (
     "promotion-raise",
 )
 INGEST_KINDS = ("gap", "out-of-order", "duplicate", "nonfinite", "sigterm")
+FEDERATION_KINDS = (
+    "replica-kill",
+    "hang-on-drain",
+    "herd-spike",
+    "poisoned-candidate",
+)
 
 
 def _count_fault(kind: str) -> None:
@@ -623,3 +632,179 @@ class IngestFaultPlan:
                 released.append((h[1], h[2]))
         self._held = [h for h in self._held if h[0] > 0]
         return out + released
+
+
+@dataclasses.dataclass(frozen=True)
+class FederationFaultSpec:
+    """One deterministic tier-level trigger in a
+    :class:`FederationFaultPlan`, addressed by the federation router's
+    *scatter ordinal* — the 0-based count of multi-city scatter/gather
+    operations the router has run (every scatter advances it, so a plan
+    reads like a script of tier traffic).
+
+    - ``"replica-kill"`` — at scatter ordinal ``dispatch``, the router
+      hard-kills replica ``replica`` mid-traffic (one-shot): the handle
+      goes dead, its in-flight cities come back as typed per-city errors
+      (never a hung caller), and the router must re-shard the dead
+      replica's cities onto survivors.
+    - ``"hang-on-drain"`` — the next drain of replica ``replica`` stalls
+      ``hang_ms`` before its in-flight work flushes (one-shot): the
+      bounded-handover drill — a drain must report a wedged replica
+      within its timeout instead of blocking the tier forever.
+    - ``"herd-spike"`` — at scatter ordinal ``dispatch``, the open-loop
+      schedule injects ``burst`` extra back-to-back requests for
+      ``city`` (one-shot): the thundering-herd drill — one city's
+      replica saturates and must shed typed errors while the rest of
+      the tier keeps its SLO.
+    - ``"poisoned-candidate"`` — flip one bit of byte ``flip_byte`` of
+      the next candidate checkpoint whose basename matches
+      ``path_glob``, before the tier promotion gate evaluates it
+      (one-shot): the tier-wide-rejection drill — the gate must
+      quarantine the candidate exactly once, not once per replica.
+    """
+
+    kind: str
+    replica: Optional[int] = None
+    dispatch: Optional[int] = None
+    hang_ms: float = 0.0
+    city: Optional[int] = None
+    burst: int = 0
+    path_glob: str = "candidate-*.ckpt"
+    flip_byte: int = -1
+
+    def __post_init__(self):
+        if self.kind not in FEDERATION_KINDS:
+            raise ValueError(
+                f"federation fault kind must be one of {FEDERATION_KINDS}, "
+                f"got {self.kind!r}"
+            )
+        if self.kind == "replica-kill" and (
+            self.replica is None or self.dispatch is None
+        ):
+            raise ValueError(
+                "replica-kill faults need explicit replica and dispatch "
+                "ordinals"
+            )
+        if self.kind == "hang-on-drain":
+            if self.replica is None:
+                raise ValueError("hang-on-drain faults need a replica")
+            if self.hang_ms <= 0:
+                raise ValueError("hang-on-drain faults need hang_ms > 0")
+        if self.kind == "herd-spike" and (
+            self.city is None or self.dispatch is None or self.burst < 1
+        ):
+            raise ValueError(
+                "herd-spike faults need a city, a dispatch ordinal, and "
+                "burst >= 1"
+            )
+
+
+class FederationFaultPlan:
+    """Deterministic tier-level faults, consulted by the federation
+    router at scatter entry and drain entry, and by the tier promotion
+    gate before each evaluation.
+
+    Same contract as :class:`FaultPlan`: the empty plan is the
+    production default and every hook short-circuits immediately — the
+    router has no instrumented build. One-shot state lives on the plan
+    instance.
+    """
+
+    def __init__(self, *specs: FederationFaultSpec):
+        if len(specs) == 1 and not isinstance(specs[0], FederationFaultSpec):
+            specs = tuple(specs[0])  # accept FederationFaultPlan([spec, ...])
+        for s in specs:
+            if not isinstance(s, FederationFaultSpec):
+                raise TypeError(
+                    f"FederationFaultPlan takes FederationFaultSpecs, got "
+                    f"{type(s).__name__}"
+                )
+        self.specs: Tuple[FederationFaultSpec, ...] = tuple(specs)
+        self._fired: set = set()
+
+    @property
+    def active(self) -> bool:
+        return bool(self.specs)
+
+    def kill_at_scatter(self, ordinal: int) -> Optional[int]:
+        """The replica id to hard-kill at this scatter ordinal, or None
+        (one-shot). The router runs its own kill path on the returned
+        id so the drill exercises exactly the production code."""
+        if not self.specs:
+            return None
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "replica-kill" or spec.dispatch != ordinal:
+                continue
+            key = ("kill", i)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            _count_fault("replica-kill")
+            return spec.replica
+        return None
+
+    def on_drain(self, replica: int) -> None:
+        """Stall a drain of ``replica`` per any one-shot hang-on-drain
+        spec — the router's drain timeout must bound the stall."""
+        if not self.specs:
+            return
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "hang-on-drain" or spec.replica != replica:
+                continue
+            key = ("drain", i)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            _count_fault("hang-on-drain")
+            import time
+
+            time.sleep(spec.hang_ms / 1e3)
+
+    def herd_burst(self, ordinal: int) -> list:
+        """``[(city, burst), ...]`` spikes scheduled at this scatter
+        ordinal (each one-shot) — the open-loop driver injects them as
+        extra back-to-back arrivals for the city."""
+        if not self.specs:
+            return []
+        out = []
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "herd-spike" or spec.dispatch != ordinal:
+                continue
+            key = ("herd", i)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            _count_fault("herd-spike")
+            out.append((spec.city, spec.burst))
+        return out
+
+    def poison_candidate(self, path: str) -> bool:
+        """Flip a byte of ``path`` at rest per any matching one-shot
+        poisoned-candidate spec; True when the file was corrupted.
+        Called by the tier promotion gate before evaluation."""
+        if not self.specs:
+            return False
+        name = os.path.basename(path)
+        for i, spec in enumerate(self.specs):
+            if spec.kind != "poisoned-candidate":
+                continue
+            if not fnmatch.fnmatch(name, spec.path_glob):
+                continue
+            key = ("poison", i)
+            if key in self._fired:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    data = bytearray(f.read())
+                if not data:
+                    continue
+                idx = spec.flip_byte if spec.flip_byte >= 0 else len(data) // 2
+                data[idx] ^= 0x01
+                with open(path, "wb") as f:
+                    f.write(bytes(data))
+            except OSError:
+                continue
+            self._fired.add(key)
+            _count_fault("poisoned-candidate")
+            return True
+        return False

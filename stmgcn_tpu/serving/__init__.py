@@ -19,6 +19,13 @@ Layout:
   class)`` routing layer over per-class programs + micro-batchers, so
   one engine serves a whole heterogeneous fleet from one checkpoint and
   requests for different cities of a class coalesce;
+- :mod:`.federation` — :class:`FederationRouter`: city→replica
+  consistent hashing over M engine replicas with scatter/gather
+  (per-city typed outcomes, never a hung caller), tier generation
+  consistency, global admission via
+  :class:`~stmgcn_tpu.serving.admission.GlobalBudget`, and the
+  drain/re-shard/warm-spare lifecycle the ``serve-bench --federation``
+  drills exercise;
 - :mod:`.microbatch` — the request queue coalescing concurrent callers
   into one dispatch (exact-fit fast path, ``max_delay_ms`` deadline);
 - :mod:`.metrics` — per-bucket p50/p95/p99 latency, queue-wait vs
@@ -34,6 +41,7 @@ from stmgcn_tpu.serving.admission import (
     BatcherWedged,
     DeadlineExceeded,
     DispatchError,
+    GlobalBudget,
     Overloaded,
     ShedError,
 )
@@ -43,9 +51,21 @@ from stmgcn_tpu.serving.engine import (
     ServingEngine,
     serve_bucket_fn,
 )
+from stmgcn_tpu.serving.federation import (
+    CityOutcome,
+    FederationRouter,
+    HashRing,
+    ReplicaHandle,
+    ReplicaUnavailable,
+    ring_hash,
+)
 from stmgcn_tpu.serving.fleet import FleetServingEngine, fleet_bucket_fn
 from stmgcn_tpu.serving.metrics import EngineStats
-from stmgcn_tpu.serving.promotion import GateDecision, PromotionGate
+from stmgcn_tpu.serving.promotion import (
+    GateDecision,
+    PromotionGate,
+    TierPromotionGate,
+)
 from stmgcn_tpu.serving.microbatch import MicroBatcher
 from stmgcn_tpu.serving.predict import serve_predict
 
@@ -53,18 +73,26 @@ __all__ = [
     "AdmissionController",
     "BatcherWedged",
     "CheckpointWatcher",
+    "CityOutcome",
     "DeadlineExceeded",
     "DispatchError",
     "EngineStats",
+    "FederationRouter",
     "FleetServingEngine",
     "GateDecision",
+    "GlobalBudget",
+    "HashRing",
     "MicroBatcher",
     "Overloaded",
     "PromotionGate",
+    "ReplicaHandle",
+    "ReplicaUnavailable",
     "ServingEngine",
     "ShedError",
+    "TierPromotionGate",
     "fleet_bucket_fn",
     "pad_to_bucket",
+    "ring_hash",
     "serve_bucket_fn",
     "serve_predict",
     "smallest_covering_bucket",

@@ -30,10 +30,22 @@ Both knobs live on :class:`~stmgcn_tpu.config.ServingConfig`
 ``serving-slo`` lint rule. The no-SLO config (all defaults) builds no
 controller at all — the engine behaves exactly as before this layer
 existed.
+
+**Tier-wide budget** (the federation layer): per-replica bounds cannot
+see each other, so M replicas each under their local bound can still
+jointly hold M x ``queue_bound_rows`` rows — a tier-sized backlog no
+single controller would admit. :class:`GlobalBudget` is one shared
+pending-row account every replica's controller draws down at admission
+and the replica's batcher pays back as rows leave its queue (dispatch,
+expiry shed, or wedge-drain). Lock discipline: the budget has its own
+lock, always acquired *inside* a batcher's queue lock and never the
+reverse — queue-lock → budget-lock is the only order, so M batchers
+sharing one budget cannot deadlock.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 __all__ = [
@@ -41,6 +53,7 @@ __all__ = [
     "BatcherWedged",
     "DeadlineExceeded",
     "DispatchError",
+    "GlobalBudget",
     "Overloaded",
     "ShedError",
 ]
@@ -81,6 +94,58 @@ class BatcherWedged(RuntimeError):
     engine degrades to the inline ``predict_direct`` path on seeing it."""
 
 
+class GlobalBudget:
+    """One tier-wide pending-row account shared by every replica's
+    :class:`AdmissionController`.
+
+    ``try_draw`` either reserves ``n`` rows atomically or refuses (the
+    caller sheds ``Overloaded``); ``release`` pays rows back when they
+    leave a replica's queue. All state lives behind the budget's own
+    lock; callers hold at most one batcher queue lock while calling in,
+    and the budget never calls out — the queue-lock → budget-lock order
+    is acyclic by construction.
+    """
+
+    def __init__(self, total_rows: int):
+        if total_rows < 1:
+            raise ValueError(
+                f"GlobalBudget needs total_rows >= 1, got {total_rows}"
+            )
+        self.total_rows = int(total_rows)
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self._peak = 0
+        self._refused = 0
+
+    def try_draw(self, n: int) -> bool:
+        """Reserve ``n`` rows of the tier budget; False = over budget."""
+        with self._lock:
+            if self._outstanding + n > self.total_rows:
+                self._refused += 1
+                return False
+            self._outstanding += n
+            if self._outstanding > self._peak:
+                self._peak = self._outstanding
+            return True
+
+    def release(self, n: int) -> None:
+        """Pay back ``n`` rows that left a replica's queue. Clamped at
+        zero so a double-release (e.g. a wedge-drain racing an expiry
+        shed) can never manufacture budget."""
+        with self._lock:
+            self._outstanding = max(0, self._outstanding - n)
+
+    def snapshot(self) -> dict:
+        """JSON-ready accounting view (the soak record source)."""
+        with self._lock:
+            return {
+                "total_rows": self.total_rows,
+                "outstanding": self._outstanding,
+                "peak": self._peak,
+                "refused": self._refused,
+            }
+
+
 class AdmissionController:
     """Arrival-time admission decisions for one micro-batch queue.
 
@@ -88,12 +153,17 @@ class AdmissionController:
     passed in by the batcher (which owns the lock), and the per-dispatch
     device-time estimate comes from the live :class:`EngineStats` the
     same engine records into — the wait model tracks the actual host.
+    With a :class:`GlobalBudget` attached, an arrival must clear the
+    local checks *and* draw its rows from the tier account — and the
+    batcher pays the account back through :meth:`release_rows` as rows
+    leave its queue.
     """
 
-    def __init__(self, config, stats, buckets):
+    def __init__(self, config, stats, buckets, *, global_budget=None):
         self.deadline_ms: Optional[float] = config.deadline_ms
         self.queue_bound_rows: int = int(config.queue_bound_rows)
         self._stats = stats
+        self._global: Optional[GlobalBudget] = global_budget
         self._top = max(buckets)
         #: conservative floor used until the first dispatch is measured:
         #: the coalescing delay itself (a dispatch can never be estimated
@@ -137,3 +207,17 @@ class AdmissionController:
                     f"{self.deadline_ms} ms deadline at arrival — shed "
                     "instead of serving late"
                 )
+        # tier budget last: a locally-shed request must never draw it down
+        if self._global is not None and not self._global.try_draw(n_rows):
+            self._stats.record_shed("tier-overloaded")
+            raise Overloaded(
+                f"tier-wide budget of {self._global.total_rows} pending "
+                f"rows is exhausted — request of {n_rows} rows shed"
+            )
+
+    def release_rows(self, n_rows: int) -> None:
+        """Pay ``n_rows`` back to the tier budget (no-op without one).
+        The batcher calls this wherever admitted rows leave its queue:
+        dispatch take, in-queue expiry, and the wedge drain."""
+        if self._global is not None and n_rows:
+            self._global.release(n_rows)

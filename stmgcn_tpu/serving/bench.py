@@ -31,8 +31,22 @@ baseline, generation-labeled gauges reset by the swap —
 ``record["soak"]["continual"]``: the closed-loop continual drill
 (:func:`stmgcn_tpu.train.continual.closed_loop_smoke` — live ring
 ingest, a triggered fine-tune, one guarded promotion, one poisoned
-candidate rejected as ``nonfinite`` while serving continues). NOT imported
-by ``stmgcn_tpu.serving.__init__`` — the throwaway-checkpoint trainer
+candidate rejected as ``nonfinite`` while serving continues).
+``--federation M`` adds the replica-tier soak (:func:`run_federation_soak`,
+``record["federation"]``): M fleet replicas plus one warm spare behind a
+:class:`~stmgcn_tpu.serving.federation.FederationRouter` under open-loop
+multi-city scatter/gather load, drilled through four deterministic fault
+legs — replica-kill mid-traffic (hash-ring heal, typed per-city errors,
+zero hung callers), thundering-herd city spike against the shared
+:class:`~stmgcn_tpu.serving.admission.GlobalBudget`, tier-wide poisoned
+candidate rejection (quarantined once, not M times) followed by a
+mid-soak tier-wide promotion with zero cross-generation responses, and
+hang-on-drain + warm-spare re-shard under load with bounded handover.
+Capacity is *measured* against the single-engine calibration
+(``capacity_x``) with core count and host-load provenance in the record
+— on a 1-core host the tier cannot multiply wall-clock compute, and the
+record says so instead of pretending. NOT imported by
+``stmgcn_tpu.serving.__init__`` — the throwaway-checkpoint trainer
 pulls the full stack, and the serving package must stay lean for
 ``stmgcn_tpu.export``.
 
@@ -61,8 +75,10 @@ import numpy as np
 from stmgcn_tpu.serving.metrics import percentiles
 
 __all__ = [
+    "federation_forecaster",
     "fleet_forecaster",
     "main",
+    "run_federation_soak",
     "run_fleet_serve_bench",
     "run_serve_bench",
     "run_soak_leg",
@@ -751,6 +767,451 @@ def run_soak_leg(fc, supports, *, buckets=(1, 4, 16),
     }
 
 
+def federation_forecaster(fc, supports, n_cities: int = 8):
+    """Lift the throwaway checkpoint into a C-city *homogeneous* fleet
+    view for the federation tier: every city is the trained 4x4 grid, so
+    all land in one shape class, any replica can serve any city (ring
+    ownership is routing policy, not capability — a re-shard never
+    rebuilds an engine), and same-class requests coalesce. Returns
+    ``(hetero_fc, per_city_supports, n_nodes)``."""
+    from stmgcn_tpu.data import MinMaxNormalizer, synthetic_dataset
+    from stmgcn_tpu.inference import Forecaster
+
+    cfg = fc.config
+    m = cfg.model.m_graphs
+    sup = np.asarray(supports, np.float32)[:m]
+    norm = (
+        fc.normalizer if fc.normalizer is not None
+        else MinMaxNormalizer.fit(
+            np.asarray(
+                synthetic_dataset(rows=4, n_timesteps=24 * 7 * 2 + 40,
+                                  seed=1).demand
+            )
+        )
+    )
+    hetero = Forecaster(
+        fc.model, fc.params, None, cfg,
+        {"input_dim": fc.derived["input_dim"],
+         "n_nodes": [sup.shape[-1]] * n_cities},
+        [norm] * n_cities,
+    )
+    return hetero, [sup] * n_cities, [sup.shape[-1]] * n_cities
+
+
+def run_federation_soak(fc, supports, *, replicas: int = 4,
+                        n_cities: int = 0, buckets=(1, 4, 16),
+                        max_delay_ms: float = 2.0,
+                        soak_seconds: float = 2.0, overload: float = 2.0,
+                        seed: int = 0) -> dict:
+    """The federation tier under open-loop load + four fault drills.
+
+    Builds ``replicas`` fleet engines plus one warm spare over a C-city
+    homogeneous view (:func:`federation_forecaster`; C defaults to
+    ``max(2 * replicas, 4)`` so the ``federation-config`` topology rule
+    holds), shares one :class:`GlobalBudget` across every replica's
+    admission controller, and routes multi-city scatter/gather requests
+    through a :class:`FederationRouter`. The drills, all driven by one
+    deterministic :class:`~stmgcn_tpu.resilience.FederationFaultPlan`:
+
+    1. **tier-wide rejection** (pre-soak) — a candidate checkpoint is
+       byte-poisoned at rest; the :class:`TierPromotionGate` must
+       quarantine it exactly once (one rename, one rejection count),
+       with every replica untouched.
+    2. **replica-kill mid-traffic** — at a scheduled scatter ordinal a
+       replica is hard-killed; its cities re-shard away on the hash
+       ring, affected in-flight cities come back as *typed* errors,
+       and no caller hangs.
+    3. **thundering-herd** — a scheduled burst hammers one city; local
+       queue bounds and the tier-wide budget shed typed ``Overloaded``
+       (reason ``tier-overloaded`` for global sheds), p99 of admitted
+       work stays bounded by the derived SLO.
+    4. **drain + re-shard under load** (post-soak, traffic still
+       offered) — a replica with a hang-on-drain fault drains within
+       its timeout (the hang is *bounded*, not waited out), and the
+       warm spare is promoted into the ring mid-burst with a bounded
+       handover and zero cross-generation responses.
+
+    Mid-soak, a *good* candidate goes through the tier gate: every live
+    replica cuts to the new generation and the router's gather contract
+    keeps every multi-city response single-generation
+    (``cross_generation`` must be 0). Capacity is reported as measured
+    tier throughput over the calibrated single-engine rate
+    (``capacity_x``) with ``n_cores`` and host-load provenance — wall
+    -clock honesty on shared hosts.
+    """
+    import jax
+
+    from stmgcn_tpu.config import FederationConfig, ServingConfig
+    from stmgcn_tpu.resilience.faults import (
+        FederationFaultPlan,
+        FederationFaultSpec,
+    )
+    from stmgcn_tpu.serving.admission import GlobalBudget, ShedError
+    from stmgcn_tpu.serving.federation import (
+        FederationRouter,
+        ReplicaUnavailable,
+    )
+    from stmgcn_tpu.serving.fleet import FleetServingEngine
+    from stmgcn_tpu.serving.promotion import TierPromotionGate
+    from stmgcn_tpu.train.checkpoint import save_checkpoint
+    from stmgcn_tpu.utils.hostload import host_load_snapshot, is_contended
+
+    if n_cities <= 0:
+        n_cities = max(2 * replicas, 4)
+    hetero, sups, n_nodes = federation_forecaster(fc, supports, n_cities)
+    ladder = tuple(sorted(set(buckets)))
+    top = ladder[-1]
+    seq_len = hetero.seq_len
+    input_dim = fc.derived["input_dim"]
+    rng = np.random.default_rng(seed)
+    hists = {
+        c: (rng.random((1, seq_len, n_nodes[c], input_dim)) * 50).astype(
+            np.float32
+        )
+        for c in range(n_cities)
+    }
+
+    # -- calibrate: single-engine batch-1 rate on THIS host -------------
+    probe_cfg = ServingConfig(
+        buckets=ladder, max_delay_ms=max_delay_ms, max_batch=top,
+    )
+    with FleetServingEngine.from_forecaster(
+        hetero, sups, config=probe_cfg
+    ) as probe:
+        for _ in range(3):
+            probe.predict_direct(hists[0], city=0)
+        t0 = time.perf_counter()
+        n_probe = 10
+        for _ in range(n_probe):
+            probe.predict_direct(hists[0], city=0)
+        per_dispatch_ms = (time.perf_counter() - t0) * 1e3 / n_probe
+    single_rps = 1e3 / per_dispatch_ms  # batch-1 predictions/sec
+
+    # SLO + budgets derived from the measured floor (same discipline as
+    # run_soak_leg); the tier budget sits above any single replica's
+    # local bound so the federation-config ordering contract holds
+    deadline_ms = 6.0 * per_dispatch_ms + 4.0 * max_delay_ms
+    queue_bound_rows = 4 * top
+    global_bound_rows = 2 * queue_bound_rows
+    cities_per_request = min(3, n_cities)
+    slo_target_ms = cities_per_request * (deadline_ms + 3.0 * per_dispatch_ms)
+    slo_cfg = ServingConfig(
+        buckets=ladder, max_delay_ms=max_delay_ms, max_batch=top,
+        deadline_ms=deadline_ms, queue_bound_rows=queue_bound_rows,
+    )
+    fed_cfg = FederationConfig(
+        enabled=True, replicas=replicas, spares=1,
+        global_queue_bound_rows=global_bound_rows,
+    )
+    config_findings = fed_cfg.violations(serving=slo_cfg, n_cities=n_cities)
+
+    # open-loop schedule: multi-city requests at overload x the rate one
+    # engine could serve them sequentially
+    interval_s = cities_per_request * (per_dispatch_ms / 1e3) / overload
+    n_arrivals = max(12, min(int(soak_seconds / interval_s), 600))
+    clients = min(32, max(6, int(
+        (cities_per_request * (deadline_ms + 2.0 * per_dispatch_ms) / 1e3)
+        / interval_s
+    ) + 4))
+
+    # the drill schedule, all in one deterministic plan
+    kill_rid = min(2, replicas - 1)
+    drain_rid = 1 if replicas > 1 else 0
+    spare_rid = replicas  # the warm spare's id in the router
+    kill_ordinal = max(2, n_arrivals // 3)
+    herd_city = 0
+    herd_burst_n = 4 * clients
+    herd_ordinal = max(kill_ordinal + 2, (2 * n_arrivals) // 3)
+    plan = FederationFaultPlan(
+        FederationFaultSpec(kind="poisoned-candidate",
+                            path_glob="candidate-0.ckpt"),
+        FederationFaultSpec(kind="replica-kill", replica=kill_rid,
+                            dispatch=kill_ordinal),
+        FederationFaultSpec(kind="herd-spike", city=herd_city,
+                            dispatch=herd_ordinal, burst=herd_burst_n),
+        FederationFaultSpec(kind="hang-on-drain", replica=drain_rid,
+                            hang_ms=80.0),
+    )
+
+    load_before = host_load_snapshot()
+    budget = GlobalBudget(global_bound_rows)
+    engines = [
+        FleetServingEngine.from_forecaster(
+            hetero, sups, config=slo_cfg, global_budget=budget
+        )
+        for _ in range(replicas)
+    ]
+    spare = FleetServingEngine.from_forecaster(
+        hetero, sups, config=slo_cfg, global_budget=budget
+    )
+    router = FederationRouter(
+        engines, range(n_cities), config=fed_cfg, spare_engines=[spare],
+        global_budget=budget, fault_plan=plan,
+    )
+    record: dict = {}
+    with tempfile.TemporaryDirectory(prefix="stmgcn_fed_") as tmp:
+        watch_dir = os.path.join(tmp, "watch")
+        stage_dir = os.path.join(tmp, "stage")
+        os.makedirs(stage_dir)
+        gate = TierPromotionGate(router, watch_dir)
+        clean_health = {
+            "nonfinite": 0, "grad_norm_max": 1.0, "update_ratio_max": 0.01,
+        }
+        try:
+            # -- drill 1: tier-wide rejection of a poisoned candidate --
+            poisoned = os.path.join(stage_dir, "candidate-0.ckpt")
+            save_checkpoint(poisoned, fc.params, {}, {"drill": "poison"})
+            decision_bad = gate.consider(poisoned, clean_health)
+            tier_rejection = {
+                "reason": decision_bad.reason,
+                "accepted": decision_bad.accepted,
+                "quarantined_path": os.path.basename(decision_bad.path),
+                # the gate ran once for the whole tier: one rejection,
+                # one quarantine rename — not one per replica
+                "rejections_counted": gate.rejections,
+                "generations_untouched": all(
+                    e.generation == 0 for e in router.engines().values()
+                ),
+            }
+
+            # -- soak: open-loop multi-city scatter/gather -------------
+            good = os.path.join(stage_dir, "candidate-1.ckpt")
+            new_params = jax.tree.map(lambda a: a * 1.001, fc.params)
+            save_checkpoint(good, new_params, {}, {"drill": "promote"})
+
+            req_ms: List[float] = []
+            outcome_counts = {"ok": 0}
+            cross_generation = [0]
+            herd_stats = {"extra_ok": 0, "extra_shed": 0}
+            behind = [0]
+            ok_predictions = [0]
+            lock = threading.Lock()
+            barrier = threading.Barrier(clients + 1)
+            t_start = [0.0]
+            promote_result: List[object] = []
+
+            def one_request(k: int):
+                cities_k = [
+                    (k * cities_per_request + j) % n_cities
+                    for j in range(cities_per_request)
+                ]
+                t0 = time.perf_counter()
+                outcomes = router.predict_many(
+                    {c: hists[c] for c in cities_k}
+                )
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                gens = set()
+                counts: dict = {}
+                n_ok = 0
+                for o in outcomes.values():
+                    if o.ok:
+                        n_ok += 1
+                        gens.add(o.generation)
+                    else:
+                        key = type(o.error).__name__
+                        counts[key] = counts.get(key, 0) + 1
+                mixed = len(gens) > 1
+                return dt_ms, n_ok, counts, mixed
+
+            def client(i: int):
+                mine_ms, mine_counts = [], {}
+                mine_ok = mine_mixed = mine_behind = 0
+                herd_ok = herd_shed = 0
+                barrier.wait()
+                for k in range(i, n_arrivals, clients):
+                    delay = t_start[0] + k * interval_s - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    else:
+                        mine_behind += 1  # late but fired: open loop
+                    for city, burst in plan.herd_burst(k):
+                        # the herd drill: a synchronized spike of extra
+                        # single-city arrivals on top of the schedule
+                        for _ in range(burst // clients + 1):
+                            try:
+                                router.predict(hists[city], city=city)
+                                herd_ok += 1
+                            except ShedError:
+                                herd_shed += 1
+                    dt_ms, n_ok, counts, mixed = one_request(k)
+                    mine_ms.append(dt_ms)
+                    mine_ok += n_ok
+                    mine_mixed += int(mixed)
+                    for key, n in counts.items():
+                        mine_counts[key] = mine_counts.get(key, 0) + n
+                with lock:
+                    req_ms.extend(mine_ms)
+                    ok_predictions[0] += mine_ok
+                    cross_generation[0] += mine_mixed
+                    behind[0] += mine_behind
+                    herd_stats["extra_ok"] += herd_ok
+                    herd_stats["extra_shed"] += herd_shed
+                    for key, n in mine_counts.items():
+                        outcome_counts[key] = outcome_counts.get(key, 0) + n
+
+            def mid_soak_promotion():
+                try:
+                    promote_result.append(gate.consider(good, clean_health))
+                except Exception as e:  # must land in the record, not die
+                    # silently with the timer thread
+                    promote_result.append(f"{type(e).__name__}: {e}")
+
+            threads = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(clients)
+            ]
+            for th in threads:
+                th.start()
+            promoter = threading.Timer(
+                max(0.05, n_arrivals * interval_s / 2.0), mid_soak_promotion
+            )
+            barrier.wait()
+            t_start[0] = time.perf_counter()
+            promoter.start()
+            t_soak0 = time.perf_counter()
+            deadline_join = time.monotonic() + 60.0
+            for th in threads:
+                th.join(timeout=max(0.0, deadline_join - time.monotonic()))
+            hung = sum(th.is_alive() for th in threads)
+            promoter.join()
+            soak_elapsed = time.perf_counter() - t_soak0
+            outcome_counts["ok"] = ok_predictions[0]
+            tier_rps = ok_predictions[0] / soak_elapsed
+
+            # -- drill 4: hang-on-drain, then warm spare under load ----
+            drain_report = router.drain(drain_rid)
+            burst_errors = {"ok": 0}
+            burst_mixed = [0]
+
+            def reshard_burst(i: int):
+                for k in range(6):
+                    dt_ms, n_ok, counts, mixed = one_request(
+                        n_arrivals + i * 6 + k
+                    )
+                    with lock:
+                        burst_errors["ok"] += n_ok
+                        burst_mixed[0] += int(mixed)
+                        for key, n in counts.items():
+                            burst_errors[key] = burst_errors.get(key, 0) + n
+
+            burst_threads = [
+                threading.Thread(target=reshard_burst, args=(i,))
+                for i in range(4)
+            ]
+            for th in burst_threads:
+                th.start()
+            promote_report = router.promote_spare(spare_rid)
+            for th in burst_threads:
+                th.join(30.0)
+            hung += sum(th.is_alive() for th in burst_threads)
+
+            # recovery: after kill + drain + re-shard, every city must
+            # still be served by some live replica
+            recovered = 0
+            for c in range(n_cities):
+                try:
+                    router.predict(hists[c], city=c)
+                    recovered += 1
+                except ReplicaUnavailable:
+                    pass  # no live owner: the drill failed to heal
+                except ShedError:
+                    recovered += 1  # shed on load is still a live owner
+            gens_after = {
+                str(rid): eng.generation
+                for rid, eng in router.engines().items()
+            }
+
+            pct = percentiles(req_ms)
+            record = {
+                "config": {
+                    "replicas": replicas,
+                    "spares": 1,
+                    "cities": n_cities,
+                    "vnodes": fed_cfg.vnodes,
+                    "buckets": list(ladder),
+                    "max_delay_ms": max_delay_ms,
+                    "deadline_ms": round(deadline_ms, 3),
+                    "queue_bound_rows": queue_bound_rows,
+                    "global_queue_bound_rows": global_bound_rows,
+                    "overload": overload,
+                    "soak_seconds": soak_seconds,
+                    "clients": clients,
+                    "cities_per_request": cities_per_request,
+                    "offered_requests": n_arrivals,
+                },
+                "config_findings": config_findings,
+                "calibration": {
+                    "per_dispatch_ms": round(per_dispatch_ms, 3),
+                    "single_engine_rps": round(single_rps, 1),
+                },
+                "capacity": {
+                    "tier_rps": round(tier_rps, 1),
+                    "capacity_x": round(tier_rps / single_rps, 2),
+                    "n_cores": os.cpu_count(),
+                },
+                "soak": {
+                    "offered": n_arrivals,
+                    "outcomes": outcome_counts,
+                    "cross_generation": cross_generation[0],
+                    "hung_clients": hung,
+                    "behind_schedule": behind[0],
+                    "request_latency_ms": pct,
+                    "slo_target_ms": round(slo_target_ms, 3),
+                    "slo_met": (
+                        pct["p99"] is not None and pct["p99"] <= slo_target_ms
+                    ),
+                },
+                "drills": {
+                    "tier_rejection": tier_rejection,
+                    "replica_kill": {
+                        "replica": kill_rid,
+                        "ordinal": kill_ordinal,
+                        "kills": router.kills,
+                        "cities_moved": router.cities_moved,
+                    },
+                    "herd": {
+                        "city": herd_city,
+                        "burst": herd_burst_n,
+                        **herd_stats,
+                        "tier_shed": budget.snapshot()["refused"],
+                    },
+                    "drain": drain_report,
+                    "reshard_promote": {
+                        **promote_report,
+                        "burst_outcomes": burst_errors,
+                        "burst_cross_generation": burst_mixed[0],
+                    },
+                },
+                "promotion": {
+                    "mid_soak": (
+                        {
+                            "accepted": promote_result[0].accepted,
+                            "reason": promote_result[0].reason,
+                            "generation": promote_result[0].generation,
+                        }
+                        if promote_result and not isinstance(
+                            promote_result[0], str
+                        )
+                        else (promote_result[0] if promote_result else None)
+                    ),
+                    "generations_after": gens_after,
+                    "detached_on_cutover": list(gate.detached),
+                },
+                "recovery": {
+                    "cities_serveable": recovered,
+                    "cities_total": n_cities,
+                },
+                "budget": budget.snapshot(),
+                "router": router.health(),
+            }
+        finally:
+            router.close()
+    load_after = host_load_snapshot()
+    record["host_load"] = {"before": load_before, "after": load_after}
+    record["contended"] = is_contended(record["host_load"])
+    return record
+
+
 def build_serve_bench_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="stmgcn serve-bench",
@@ -793,6 +1254,19 @@ def build_serve_bench_parser() -> argparse.ArgumentParser:
     p.add_argument("--soak-overload", type=float, default=2.0,
                    help="offered load as a multiple of calibrated capacity "
                         "(default 2.0)")
+    p.add_argument("--federation", type=int, default=0, metavar="M",
+                   help="run the M-replica federation soak "
+                        "(record['federation']): a warm spare, a shared "
+                        "tier-wide admission budget, open-loop multi-city "
+                        "scatter/gather, and the four fault drills — "
+                        "replica-kill mid-traffic, thundering-herd, "
+                        "tier-wide poisoned-candidate rejection + "
+                        "generation-consistent promotion, hang-on-drain + "
+                        "warm-spare re-shard under load (default 0: off)")
+    p.add_argument("--federation-cities", type=int, default=0,
+                   help="cities the federation shards across the hash ring "
+                        "(default 0: max(2*M, 4) — at least as many cities "
+                        "as replicas, per the federation-config rule)")
     p.add_argument("--trace-out", type=str, default=None, metavar="PATH",
                    help="record per-request spans (admit -> queue -> "
                         "device -> scatter, generation-stamped) plus JAX "
@@ -877,6 +1351,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 record["soak"]["continual"] = closed_loop_smoke(
                     os.path.join(tmp, "continual")
+                )
+                sp.end()
+            if args.federation > 0:
+                sp = _phase("bench.federation")
+                record["federation"] = run_federation_soak(
+                    fc, supports, replicas=args.federation,
+                    n_cities=args.federation_cities, buckets=buckets,
+                    max_delay_ms=args.max_delay_ms,
+                    soak_seconds=args.soak_seconds,
+                    overload=args.soak_overload,
                 )
                 sp.end()
         record["captured_at"] = time.strftime(

@@ -215,6 +215,11 @@ class CheckpointWatcher:
         stop event guarantees no *further* scans, and the thread is
         daemon, so a straggler cannot hold the process open — close()
         must never deadlock behind slow checkpoint IO.
+
+        A False return is not silent: the ``serving.watcher_wedged``
+        counter ticks and a structured ``_event`` log line names the
+        watch directory, so a federation tier drain can report *which*
+        replica's watcher refused to die instead of just timing out.
         """
         self._stop.set()
         t = self._thread
@@ -222,6 +227,13 @@ class CheckpointWatcher:
             return True
         t.join(self.JOIN_TIMEOUT_S if timeout_s is None else timeout_s)
         if t.is_alive():
+            REGISTRY.counter("serving.watcher_wedged").inc()
+            self._log(
+                f"_event=watcher_wedged dir={self.out_dir} — stop() timed "
+                "out joining an in-flight poll; the stop event blocks "
+                "further scans and the daemon thread cannot hold the "
+                "process open"
+            )
             return False
         self._thread = None
         return True
@@ -252,7 +264,8 @@ class ServingEngine:
     """
 
     def __init__(self, programs, sup_dev, supports_np, normalizer, expected,
-                 config, *, params_dev=None, fault_plan=None):
+                 config, *, params_dev=None, fault_plan=None,
+                 global_budget=None):
         self._programs = dict(programs)  # bucket -> call(params, hist) -> dev
         self._sup_dev = sup_dev
         self._supports_np = supports_np
@@ -272,8 +285,10 @@ class ServingEngine:
         )
         self._watcher: Optional[CheckpointWatcher] = None
         self.admission = (
-            AdmissionController(config, self.stats, self._buckets)
-            if config.deadline_ms is not None or config.queue_bound_rows
+            AdmissionController(config, self.stats, self._buckets,
+                                global_budget=global_budget)
+            if (config.deadline_ms is not None or config.queue_bound_rows
+                or global_budget is not None)
             else None
         )
         self._batcher = MicroBatcher(
@@ -309,7 +324,7 @@ class ServingEngine:
 
     @classmethod
     def from_forecaster(cls, fc, supports, *, config=None, city=None,
-                        fault_plan=None) -> "ServingEngine":
+                        fault_plan=None, global_budget=None) -> "ServingEngine":
         """Engine over a live :class:`~stmgcn_tpu.inference.Forecaster`.
 
         The checkpoint's model is rebuilt as its dense serving clone
@@ -388,7 +403,8 @@ class ServingEngine:
             # dispatch-path round trip)
             programs[b] = lambda p, h, c=compiled: c(p, sup_dev, h)
         engine = cls(programs, sup_dev, supports_np, normalizer, expected,
-                     cfg, params_dev=params_dev, fault_plan=fault_plan)
+                     cfg, params_dev=params_dev, fault_plan=fault_plan,
+                     global_budget=global_budget)
         # hot-swap plumbing: raw checkpoint params go through the same
         # serving transform the ladder was compiled for, and verified
         # loads restore against the live checkpoint's pytree

@@ -122,7 +122,7 @@ class FleetServingEngine:
 
     def __init__(self, plan, groups, programs, batch_buckets, normalizers,
                  city_n, seq_len, input_dim, config, *, params_dev=None,
-                 fault_plan=None):
+                 fault_plan=None, global_budget=None):
         #: the shape-class plan (extra exact-fit classes for unassigned
         #: cities appear in ``groups`` only)
         self.plan = plan
@@ -156,11 +156,13 @@ class FleetServingEngine:
         self.class_stats = {
             ci: EngineStats() for ci in range(len(self._groups))
         }
-        slo = config.deadline_ms is not None or config.queue_bound_rows
+        slo = (config.deadline_ms is not None or config.queue_bound_rows
+               or global_budget is not None)
         self.class_admission = {
             ci: (
                 AdmissionController(config, self.class_stats[ci],
-                                    self._buckets)
+                                    self._buckets,
+                                    global_budget=global_budget)
                 if slo else None
             )
             for ci in range(len(self._groups))
@@ -188,7 +190,8 @@ class FleetServingEngine:
     @classmethod
     def from_forecaster(cls, fc, city_supports, *, config=None,
                         max_classes: int = 8, max_pad_waste: float = 0.5,
-                        fault_plan=None) -> "FleetServingEngine":
+                        fault_plan=None, global_budget=None
+                        ) -> "FleetServingEngine":
         """Engine over a heterogeneous multi-city checkpoint.
 
         ``city_supports``: one dense ``(M, K, n_c, n_c)`` stack per city
@@ -322,7 +325,8 @@ class FleetServingEngine:
                 )
         engine = cls(plan, groups, programs, cfg.buckets, normalizers,
                      n_nodes, seq_len, input_dim, cfg,
-                     params_dev=params_dev, fault_plan=fault_plan)
+                     params_dev=params_dev, fault_plan=fault_plan,
+                     global_budget=global_budget)
         engine._prepare_params = lambda p: to_dense_serving(fc.model, p, m)[1]
         engine._params_template = fc.params
         hb = getattr(fc, "health_baseline", None)

@@ -212,6 +212,8 @@ class MicroBatcher:
         ):
             req = self._pending.popleft()
             self._pending_rows -= req.n
+            if self._admission is not None:
+                self._admission.release_rows(req.n)
             req.error = DeadlineExceeded(
                 f"request expired in queue after "
                 f"{(now - req.t_enqueue) * 1e3:.1f} ms — shed at the "
@@ -231,6 +233,8 @@ class MicroBatcher:
             batch.append(req)
             total += req.n
         self._pending_rows -= total
+        if self._admission is not None:
+            self._admission.release_rows(total)
         return batch
 
     def _run(self) -> None:
@@ -244,6 +248,8 @@ class MicroBatcher:
                     req = self._pending.popleft()
                     req.error = self._wedged_error()
                     req.done = True
+                if self._admission is not None:
+                    self._admission.release_rows(self._pending_rows)
                 self._pending_rows = 0
                 self._done.notify_all()
 

@@ -34,6 +34,17 @@ Promotion-stage fault drills: the engine's
 ``promotion-raise`` shot at the top of each gate evaluation; an
 injected gate crash quarantines the candidate with reason
 ``"gate-error"`` rather than touching the serving path.
+
+:class:`TierPromotionGate` lifts the same door to a federation of M
+replicas: the candidate is evaluated **once** (one integrity read, one
+held-out eval — not M), a rejection quarantines it **once** (the
+rename happens before any replica's watcher could see the file), and
+an acceptance is one rotation followed by a cutover poll on *every*
+replica's watcher over the shared watch directory. A replica whose
+poll fails is detached from the serving ring rather than left serving
+the old generation — the tier never holds a mixed-generation active
+set, and the router's gather-retry covers callers that race the
+cutover window between polls.
 """
 
 from __future__ import annotations
@@ -49,7 +60,7 @@ import numpy as np
 from stmgcn_tpu.obs import trace as obs_trace
 from stmgcn_tpu.obs.registry import REGISTRY
 
-__all__ = ["GateDecision", "PromotionGate"]
+__all__ = ["GateDecision", "PromotionGate", "TierPromotionGate"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,3 +251,110 @@ class PromotionGate:
     def _count_reject(self, reason: str) -> None:
         self.rejections += 1
         self._reg.counter("continual.rejections", {"reason": reason}).inc()
+
+
+class TierPromotionGate(PromotionGate):
+    """One promotion door for a whole replica tier.
+
+    Built over a :class:`~stmgcn_tpu.serving.federation
+    .FederationRouter`: every replica (active *and* warm spare — a
+    spare promoted later must not time-travel) gets a checkpoint
+    watcher over the same ``out_dir``, and the base gate's evaluation
+    chain runs against one designated primary replica. The tier
+    contract on top of the single-engine gate:
+
+    - **evaluate once** — integrity/health/eval checks run once for
+      the tier, not once per replica;
+    - **quarantine once** — a rejected candidate is renamed away
+      before any watcher could observe it, so a poisoned candidate
+      costs one quarantine, not M;
+    - **generation-consistent cutover** — acceptance rotates
+      ``latest.ckpt`` once, then polls every live replica's watcher;
+      a replica whose poll fails (torn read, wedged loop) is detached
+      from the ring via :meth:`FederationRouter.detach` instead of
+      serving the previous generation.
+
+    A :class:`~stmgcn_tpu.resilience.FederationFaultPlan` attached to
+    the router gets its ``poisoned-candidate`` shot (an at-rest byte
+    flip) before evaluation — the drilled path *is* the integrity
+    check.
+    """
+
+    def __init__(self, router, out_dir: str, **kwargs):
+        engines = router.engines()
+        if not engines:
+            raise ValueError("TierPromotionGate needs at least one live replica")
+        self.router = router
+        self._primary_rid = next(iter(engines))
+        super().__init__(engines[self._primary_rid], out_dir, **kwargs)
+        # base __init__ already pointed the primary's watcher here
+        self.watchers = {self._primary_rid: self.watcher}
+        for rid, eng in engines.items():
+            if rid != self._primary_rid:
+                self.watchers[rid] = eng.watch_checkpoints(out_dir)
+        self.detached: list[int] = []
+
+    @classmethod
+    def from_config(cls, router, out_dir: str, config, **kwargs) -> "TierPromotionGate":
+        """Build with the bands of a :class:`~stmgcn_tpu.config
+        .ContinualConfig` (mirrors :meth:`PromotionGate.from_config`)."""
+        return cls(
+            router, out_dir,
+            grad_norm_max=config.promote_grad_norm_max,
+            update_ratio_max=config.promote_update_ratio_max,
+            eval_margin=config.promote_eval_margin,
+            **kwargs,
+        )
+
+    def consider(self, candidate_path: str, health: dict) -> GateDecision:
+        plan = getattr(self.router, "_fault_plan", None)
+        if plan is not None:
+            # at-rest poisoning lands *before* the integrity check — the
+            # drill asserts the tier rejects it exactly once
+            plan.poison_candidate(candidate_path)
+        return super().consider(candidate_path, health)
+
+    def _promote(self, path: str, ordinal: int, checks: dict) -> GateDecision:
+        latest = os.path.join(self.out_dir, "latest.ckpt")
+        prev = os.path.join(self.out_dir, "latest.prev.ckpt")
+        try:
+            os.replace(latest, prev)
+        except OSError:  # first promotion: nothing to rotate
+            pass
+        os.replace(path, latest)
+        params = checks.pop("_params", None)
+        live = self.router.engines()  # killed/detached replicas skip cutover
+        swapped, failed = [], []
+        for rid in sorted(self.watchers):
+            if rid not in live:
+                continue
+            if self.watchers[rid].poll():
+                swapped.append(rid)
+            else:
+                failed.append(rid)
+        if not swapped:
+            # nothing cut over: every engine is untouched, report as the
+            # base gate does for a single failed swap
+            self._count_reject("swap-failed")
+            self._log(f"tier promotion {ordinal}: rotated {latest} but no "
+                      "replica applied a swap")
+            return GateDecision(False, "swap-failed", ordinal, latest,
+                                self._engine.generation, checks)
+        for rid in failed:
+            moved = self.router.detach(rid)
+            self.detached.append(rid)
+            self._log(f"tier promotion {ordinal}: replica {rid} missed the "
+                      f"cutover — detached from the ring ({moved} cities "
+                      "moved)")
+        gens = {rid: live[rid].generation for rid in swapped}
+        checks["tier"] = {"swapped": swapped, "failed": failed,
+                          "generations": gens}
+        if params is not None:
+            self._live_params = jax.tree.map(np.asarray, params)
+        self.promotions += 1
+        self._reg.counter("continual.promotions").inc()
+        generation = max(gens.values())
+        self._log(f"tier promotion {ordinal}: {latest} -> generation "
+                  f"{generation} on replicas {swapped}")
+        return GateDecision(True, "promoted", ordinal, latest, generation,
+                            checks)

@@ -268,7 +268,8 @@ class ContinualDaemon:
 
     def __init__(self, trainer: ContinualTrainer, gate, *, config,
                  time_fn=time.monotonic, sleep_fn=time.sleep,
-                 rng_seed: int = 0, registry=None, log=None):
+                 rng_seed: int = 0, registry=None, log=None,
+                 replica: Optional[str] = None):
         self.trainer = trainer
         self.gate = gate
         self.config = config
@@ -277,12 +278,15 @@ class ContinualDaemon:
         self._rng = random.Random(rng_seed)
         self._reg = REGISTRY if registry is None else registry
         self._log = log if log is not None else (lambda msg: None)
+        # federation shards run one daemon each: a replica label keeps
+        # their up/down gauges distinguishable in one registry
+        self._labels = None if replica is None else {"replica": str(replica)}
         self._last_retrain = time_fn()
         self.down = False
         self.restarts = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._reg.gauge("continual.daemon_up").set(1)
+        self._reg.gauge("continual.daemon_up", self._labels).set(1)
 
     # -- trigger ---------------------------------------------------------
 
@@ -340,7 +344,7 @@ class ContinualDaemon:
                 self.restarts += 1
                 if attempts > cfg.max_restarts:
                     self.down = True
-                    self._reg.gauge("continual.daemon_up").set(0)
+                    self._reg.gauge("continual.daemon_up", self._labels).set(0)
                     self._log(f"retrain ({reason}) abandoned after "
                               f"{attempts} attempts: {e!r} — daemon down, "
                               "serving continues on the live generation")

@@ -1109,6 +1109,129 @@ class TestContinualConfigRule:
         assert check_continual_config([("none", object())]) == []
 
 
+class TestFederationConfigRule:
+    """Pass 2k: the federation-config contract — tier topology knobs
+    that break deployment before any request is served. Boundaries
+    pinned like the other contract rules: the limits themselves are
+    clean, one past them is flagged; replica/budget/lifecycle checks
+    only gate once the tier is enabled (ring-shape bounds always
+    apply — the hash math exists with the router off)."""
+
+    @staticmethod
+    def _cfg(**kw):
+        from stmgcn_tpu.config import FederationConfig, preset
+
+        cfg = preset("smoke")
+        cfg.federation = FederationConfig(**kw)
+        return cfg
+
+    def test_rule_registered_as_error(self):
+        assert RULES["federation-config"].severity == "error"
+
+    def test_all_presets_clean(self):
+        from stmgcn_tpu.analysis import check_federation_config
+
+        assert check_federation_config() == []
+
+    def test_replicas_vs_cities_boundary(self):
+        from stmgcn_tpu.analysis import check_federation_config
+
+        # smoke has 1 city: replicas == n_cities is the last clean point
+        assert check_federation_config(
+            [("ok", self._cfg(enabled=True, replicas=1))]
+        ) == []
+        f = check_federation_config(
+            [("bad", self._cfg(enabled=True, replicas=2))]
+        )
+        assert f and all(x.rule == "federation-config" for x in f)
+        assert all(x.severity == "error" for x in f)
+        assert f[0].path == "<contract:federation:bad>"
+        assert any("permanently idle" in x.message for x in f)
+
+    def test_ring_points_vs_imbalance_boundary(self):
+        from stmgcn_tpu.analysis import check_federation_config
+
+        # imbalance 0.5 needs 4/0.25 = 16 points: 1x16 clean, 1x15 not
+        assert check_federation_config(
+            [("ok", self._cfg(enabled=True, replicas=1, vnodes=16))]
+        ) == []
+        f = check_federation_config(
+            [("bad", self._cfg(enabled=True, replicas=1, vnodes=15))]
+        )
+        assert any("bound imbalance" in x.message for x in f)
+        with_bound = self._cfg(enabled=True, replicas=1, vnodes=15,
+                               imbalance_max=1.0)
+        assert check_federation_config([("ok", with_bound)]) == []
+
+    def test_global_budget_vs_local_bound_boundary(self):
+        from stmgcn_tpu.config import ServingConfig
+        from stmgcn_tpu.analysis import check_federation_config
+
+        ok = self._cfg(enabled=True, replicas=1,
+                       global_queue_bound_rows=64)
+        ok.serving = ServingConfig(buckets=(1, 16), max_batch=16,
+                                   queue_bound_rows=64)
+        assert check_federation_config([("ok", ok)]) == []
+        bad = self._cfg(enabled=True, replicas=1,
+                        global_queue_bound_rows=63)
+        bad.serving = ok.serving
+        f = check_federation_config([("bad", bad)])
+        assert any("per-replica bound" in x.message for x in f)
+
+    def test_global_budget_vs_top_rung_boundary(self):
+        from stmgcn_tpu.config import ServingConfig
+        from stmgcn_tpu.analysis import check_federation_config
+
+        # no local queue bound, so only the top-rung floor applies
+        srv = ServingConfig(buckets=(1, 16), max_batch=16)
+        ok = self._cfg(enabled=True, replicas=1, global_queue_bound_rows=16)
+        ok.serving = srv
+        assert check_federation_config([("ok", ok)]) == []
+        bad = self._cfg(enabled=True, replicas=1, global_queue_bound_rows=15)
+        bad.serving = srv
+        f = check_federation_config([("bad", bad)])
+        assert any("top ladder rung" in x.message for x in f)
+
+    def test_handover_must_not_exceed_drain(self):
+        from stmgcn_tpu.analysis import check_federation_config
+
+        assert check_federation_config(
+            [("ok", self._cfg(enabled=True, replicas=1,
+                              drain_timeout_s=2.0, handover_timeout_s=2.0))]
+        ) == []
+        f = check_federation_config(
+            [("bad", self._cfg(enabled=True, replicas=1,
+                               drain_timeout_s=2.0,
+                               handover_timeout_s=2.001))]
+        )
+        assert any("never be allowed longer than a full drain" in x.message
+                   for x in f)
+        f = check_federation_config(
+            [("bad", self._cfg(enabled=True, replicas=1,
+                               drain_timeout_s=0.0))]
+        )
+        assert any("timeouts must be positive" in x.message for x in f)
+
+    def test_disabled_tier_is_dormant_config(self):
+        from stmgcn_tpu.analysis import check_federation_config
+
+        # tier off: absurd replica/budget/lifecycle knobs are dormant,
+        # but the ring-shape bounds still apply (the hash math is global)
+        assert check_federation_config(
+            [("off", self._cfg(enabled=False, replicas=99,
+                               handover_timeout_s=99.0))]
+        ) == []
+        f = check_federation_config(
+            [("off", self._cfg(enabled=False, vnodes=0))]
+        )
+        assert any("vnodes" in x.message for x in f)
+
+    def test_configs_without_federation_section_skipped(self):
+        from stmgcn_tpu.analysis import check_federation_config
+
+        assert check_federation_config([("none", object())]) == []
+
+
 class TestResidentMemoryRule:
     """Pass 2f: the resident-memory footprint contract (pure config math
     — the same arithmetic as DemandDataset.resident_nbytes/nbytes,
@@ -2428,7 +2551,7 @@ class TestLintGateScript:
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         proc = subprocess.run(
             ["bash", os.path.join(repo, "scripts", "lint_gate.sh")],
-            capture_output=True, text=True, timeout=600,
+            capture_output=True, text=True, timeout=900,
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
@@ -2463,6 +2586,18 @@ class TestLintGateScript:
         assert payload["continual"] == {
             "exit": 0, "promotions": 1, "rejections": 1, "nonfinite": 0,
         }
+        # the federation kill-and-recover drill: no hung caller, no
+        # cross-generation response, the scheduled kill fired, every
+        # city serveable again afterwards, presets pass the topology
+        # contract
+        assert payload["federation"]["exit"] == 0
+        assert payload["federation"]["hung"] == 0
+        assert payload["federation"]["cross_generation"] == 0
+        assert payload["federation"]["kills"] == 1
+        assert payload["federation"]["recovered"] == \
+            payload["federation"]["cities"]
+        assert payload["federation"]["cities"] > 0
+        assert payload["federation"]["findings"] == 0
         # the spmd contract section: every probe program lowered on the
         # virtual mesh, collectives observed, zero manifest/wire/
         # footprint findings

@@ -1,0 +1,638 @@
+"""Federation-tier contracts: hash ring, global budget, scatter/gather,
+lifecycle, and the tier promotion gate.
+
+Engine-free like the admission units — the router's contracts (ring
+determinism, minimal-movement re-shard, per-city typed partial-failure
+outcomes, single-generation gathers, bounded drains) are routing-layer
+properties, so fake replicas pin them fast and deterministically; the
+real M-replica engines are exercised by the slow-tier soak contract
+test at the bottom, which runs ``serve-bench --soak --federation`` as a
+subprocess and asserts the one-JSON-line stdout record the lint gate
+and README numbers come from.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from stmgcn_tpu.config import FederationConfig, ServingConfig
+from stmgcn_tpu.resilience import FederationFaultPlan, FederationFaultSpec
+from stmgcn_tpu.serving import (
+    AdmissionController,
+    CityOutcome,
+    FederationRouter,
+    GlobalBudget,
+    HashRing,
+    Overloaded,
+    ReplicaUnavailable,
+    ShedError,
+    TierPromotionGate,
+    ring_hash,
+)
+from stmgcn_tpu.serving.metrics import EngineStats
+
+
+# ---------------------------------------------------------------------------
+# fakes: the router only needs predict/close/generation/drift_snapshot
+
+
+class FakeWatcher:
+    """Stands in for CheckpointWatcher in tier-gate tests: poll() applies
+    'the new checkpoint' by bumping its engine's generation."""
+
+    def __init__(self, engine, fail=False):
+        self._engine = engine
+        self.fail = fail
+        self.polls = 0
+        self.stopped = False
+
+    def poll(self):
+        self.polls += 1
+        if self.fail:
+            return False
+        self._engine.generation += 1
+        return True
+
+    def stop(self, timeout_s=None):
+        self.stopped = True
+        return True
+
+
+class FakeEngine:
+    """A replica double: serves any city, typed-raises on demand, and
+    carries the generation/watcher surface the router + tier gate use."""
+
+    def __init__(self, *, shed_cities=(), delay_s=0.0, watcher_fails=False):
+        self.generation = 0
+        self.shed_cities = set(shed_cities)
+        self.delay_s = delay_s
+        self.watcher_fails = watcher_fails
+        self.closed = False
+        self.calls = []
+        self._params_template = None
+        self._watcher = None
+
+    def predict(self, history, *, city, with_generation=False):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if city in self.shed_cities:
+            raise Overloaded(f"fake shed for city {city}")
+        self.calls.append(city)
+        out = np.full((1, 2), float(city), np.float32)
+        return (out, self.generation) if with_generation else out
+
+    def drift_snapshot(self):
+        return {"cities": {"0": {"input": {"z_max": 0.5 + self.generation,
+                                           "psi": 0.1}}}}
+
+    def watch_checkpoints(self, out_dir, **kwargs):
+        self._watcher = FakeWatcher(self, fail=self.watcher_fails)
+        return self._watcher
+
+    def close(self):
+        self.closed = True
+
+
+def make_router(n_replicas=3, n_cities=9, *, spares=0, fault_plan=None,
+                engine_factory=FakeEngine, budget=None):
+    engines = [engine_factory() for _ in range(n_replicas)]
+    spare_engines = [engine_factory() for _ in range(spares)]
+    cfg = FederationConfig(enabled=True, replicas=n_replicas, spares=spares)
+    router = FederationRouter(
+        engines, range(n_cities), config=cfg, spare_engines=spare_engines,
+        global_budget=budget, fault_plan=fault_plan,
+    )
+    return router, engines, spare_engines
+
+
+HIST = np.zeros((1, 3), np.float32)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestHashRing:
+    def test_ring_hash_is_process_salt_free(self):
+        # Python's builtin hash() is salted per process; the ring hash
+        # must not be — replica layouts have to agree across runs/hosts
+        assert ring_hash("city:0") == ring_hash("city:0")
+        assert ring_hash("city:0") != ring_hash("city:1")
+        # pinned: a changed hash silently re-shards every deployment
+        assert ring_hash("replica:0#0") == 0xC92D06DA2EFA9FE3
+
+    def test_owner_deterministic_and_total(self):
+        ring = HashRing([0, 1, 2], vnodes=64)
+        a = ring.assignment(range(50))
+        b = HashRing([2, 1, 0], vnodes=64).assignment(range(50))
+        assert a == b  # membership order must not matter
+        assert set(a) == set(range(50))
+        assert set(a.values()) <= {0, 1, 2}
+
+    def test_removal_moves_only_the_removed_replicas_cities(self):
+        cities = range(64)
+        before = HashRing([0, 1, 2], vnodes=64).assignment(cities)
+        after = HashRing([0, 2], vnodes=64).assignment(cities)
+        for c in cities:
+            if before[c] != 1:
+                # consistent hashing's whole point: survivors keep theirs
+                assert after[c] == before[c]
+            else:
+                assert after[c] in (0, 2)
+
+    def test_addition_only_steals(self):
+        cities = range(64)
+        before = HashRing([0, 1], vnodes=64).assignment(cities)
+        after = HashRing([0, 1, 2], vnodes=64).assignment(cities)
+        for c in cities:
+            assert after[c] == before[c] or after[c] == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            HashRing([], vnodes=4)
+        with pytest.raises(ValueError):
+            HashRing([0], vnodes=0)
+
+    def test_imbalance_zero_for_single_replica(self):
+        assert HashRing([7], vnodes=4).imbalance(range(10)) == 0.0
+        assert HashRing([0, 1], vnodes=64).imbalance([]) == 0.0
+
+
+class TestGlobalBudget:
+    def test_draw_release_refuse(self):
+        b = GlobalBudget(10)
+        assert b.try_draw(6) and b.try_draw(4)
+        assert not b.try_draw(1)
+        b.release(4)
+        assert b.try_draw(3)
+        snap = b.snapshot()
+        assert snap == {"total_rows": 10, "outstanding": 9, "peak": 10,
+                        "refused": 1}
+
+    def test_double_release_cannot_manufacture_budget(self):
+        b = GlobalBudget(4)
+        assert b.try_draw(4)
+        b.release(4)
+        b.release(4)  # double pay-back: clamped, not banked
+        assert b.snapshot()["outstanding"] == 0
+        assert b.try_draw(4)
+        assert not b.try_draw(1)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            GlobalBudget(0)
+
+    def test_concurrent_accounting_is_exact(self):
+        b = GlobalBudget(8)
+        held = []
+        lock = threading.Lock()
+        refused = [0]
+
+        def worker():
+            for _ in range(200):
+                if b.try_draw(1):
+                    with lock:
+                        held.append(1)
+                    b.release(1)
+                    with lock:
+                        held.pop()
+                else:
+                    with lock:
+                        refused[0] += 1
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not any(t.is_alive() for t in threads)
+        snap = b.snapshot()
+        assert snap["outstanding"] == 0  # everything paid back
+        assert snap["peak"] <= 8  # the invariant the budget exists for
+
+    def test_admission_sheds_tier_overloaded_after_local_checks(self):
+        cfg = ServingConfig(buckets=(1, 4), max_batch=4,
+                            queue_bound_rows=100)
+        stats = EngineStats()
+        budget = GlobalBudget(4)
+        ctl = AdmissionController(cfg, stats, (1, 4), global_budget=budget)
+        ctl.admit(4, 0)  # locally fine, draws the whole tier budget
+        with pytest.raises(Overloaded, match="tier-wide"):
+            ctl.admit(1, 4)
+        assert stats.shed_counts().get("tier-overloaded") == 1
+        # a locally-shed request must never have drawn tier budget
+        with pytest.raises(Overloaded, match="queue holds"):
+            ctl.admit(200, 0)
+        assert budget.snapshot()["outstanding"] == 4
+        ctl.release_rows(4)
+        assert budget.snapshot()["outstanding"] == 0
+
+
+class TestFederationRouter:
+    def test_predict_routes_to_ring_owner(self):
+        router, engines, _ = make_router()
+        try:
+            for c in range(9):
+                out = router.predict(HIST, city=c)
+                assert float(out[0, 0]) == float(c)
+                rid = router.replica_for(c)
+                assert c in engines[rid].calls
+        finally:
+            router.close()
+
+    def test_predict_unknown_city_raises(self):
+        router, _, _ = make_router(n_cities=4)
+        try:
+            with pytest.raises(ValueError, match="city must be one of"):
+                router.predict(HIST, city=99)
+        finally:
+            router.close()
+
+    def test_predict_many_single_generation_all_ok(self):
+        router, _, _ = make_router()
+        try:
+            outcomes = router.predict_many({c: HIST for c in range(9)})
+            assert set(outcomes) == set(range(9))
+            assert all(o.ok for o in outcomes.values())
+            assert {o.generation for o in outcomes.values()} == {0}
+        finally:
+            router.close()
+
+    def test_partial_failure_is_typed_per_city(self):
+        # one replica sheds its cities: those cities come back with their
+        # own typed error; sibling cities are unaffected — and the caller
+        # is never handed an exception or a hang, only outcomes
+        router, engines, _ = make_router(n_replicas=3, n_cities=12)
+        try:
+            victim = router.replica_for(0)
+            engines[victim].shed_cities = set(range(12))
+            outcomes = router.predict_many({c: HIST for c in range(12)})
+            for c, o in outcomes.items():
+                if router.replica_for(c) == victim:
+                    assert not o.ok
+                    assert isinstance(o.error, Overloaded)
+                    assert o.replica == victim
+                else:
+                    assert o.ok
+        finally:
+            router.close()
+
+    def test_kill_heals_ring_and_keeps_every_city_served(self):
+        router, engines, _ = make_router(n_replicas=3, n_cities=12)
+        try:
+            before = router.assignment()
+            victim = before[0]
+            owned = [c for c, r in before.items() if r == victim]
+            router.kill(victim)
+            after = router.assignment()
+            assert victim not in after.values()
+            # minimal movement: only the dead replica's cities moved
+            for c, r in before.items():
+                if r != victim:
+                    assert after[c] == r
+            assert router.cities_moved == len(owned)
+            for c in range(12):
+                assert router.predict(HIST, city=c) is not None
+            deadline = time.monotonic() + 5.0
+            while not engines[victim].closed and time.monotonic() < deadline:
+                time.sleep(0.01)  # the reaper closes off the scatter path
+            assert engines[victim].closed
+        finally:
+            router.close()
+
+    def test_fault_plan_kill_at_scatter_never_hangs_a_caller(self):
+        plan = FederationFaultPlan(
+            FederationFaultSpec(kind="replica-kill", replica=0, dispatch=0)
+        )
+        router, engines, _ = make_router(n_replicas=3, n_cities=12,
+                                         fault_plan=plan)
+        try:
+            outcomes = router.predict_many({c: HIST for c in range(12)})
+            assert set(outcomes) == set(range(12))
+            # every city answered or failed typed — none missing, none hung
+            for o in outcomes.values():
+                assert o.ok or isinstance(o.error, ShedError)
+            assert router.kills == 1
+            assert 0 not in router.assignment().values()
+            # the plan is one-shot: the next scatter kills nobody
+            router.predict_many({0: HIST})
+            assert router.kills == 1
+        finally:
+            router.close()
+
+    def test_generation_split_never_yields_mixed_success(self):
+        router, engines, _ = make_router(n_replicas=2, n_cities=8)
+        try:
+            laggard = router.replica_for(0)
+            for i, e in enumerate(engines):
+                if i != laggard:
+                    e.generation = 1  # the tier cut over; one replica lags
+            outcomes = router.predict_many({c: HIST for c in range(8)})
+            ok_gens = {o.generation for o in outcomes.values() if o.ok}
+            assert len(ok_gens) == 1  # the tier contract
+            for c, o in outcomes.items():
+                if router.replica_for(c) == laggard:
+                    assert not o.ok
+                    assert isinstance(o.error, ReplicaUnavailable)
+            assert router.generation_retries > 0
+        finally:
+            router.close()
+
+    def test_drain_flushes_and_reassigns(self):
+        plan = FederationFaultPlan(
+            FederationFaultSpec(kind="hang-on-drain", replica=1, hang_ms=30.0)
+        )
+        router, engines, _ = make_router(n_replicas=3, n_cities=12,
+                                         fault_plan=plan)
+        try:
+            owned = [c for c, r in router.assignment().items() if r == 1]
+            t0 = time.perf_counter()
+            report = router.drain(1)
+            elapsed_s = time.perf_counter() - t0
+            assert report["flushed"] is True
+            assert report["moved_cities"] == len(owned)
+            assert report["watcher_wedged"] is False
+            # the injected 30 ms hang is *bounded* by the drain window
+            assert elapsed_s < router.config.drain_timeout_s + 1.0
+            assert 1 not in router.assignment().values()
+            for c in range(12):
+                router.predict(HIST, city=c)
+        finally:
+            router.close()
+
+    def test_promote_spare_joins_ring_with_bounded_handover(self):
+        router, engines, spares = make_router(n_replicas=2, n_cities=8,
+                                              spares=1)
+        try:
+            spare_rid = 2
+            with pytest.raises(ValueError, match="not a spare"):
+                router.promote_spare(0)
+            report = router.promote_spare(spare_rid)
+            assert report["promoted"] == spare_rid
+            assert report["handover_flushed"] is True
+            assert spare_rid in router.assignment().values()
+            # addition only steals: no city moved between the survivors
+            assert report["moved_cities"] == sum(
+                1 for r in router.assignment().values() if r == spare_rid
+            )
+        finally:
+            router.close()
+
+    def test_concurrent_scatters_account_globally(self):
+        budget = GlobalBudget(1000)
+        router, engines, _ = make_router(n_replicas=3, n_cities=9,
+                                         budget=budget)
+        try:
+            errs = []
+
+            def caller():
+                try:
+                    outs = router.predict_many({c: HIST for c in range(9)})
+                    assert all(o.ok for o in outs.values())
+                except Exception as e:  # surfaced below, not swallowed
+                    errs.append(e)
+
+            threads = [threading.Thread(target=caller) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30.0)
+            assert not any(t.is_alive() for t in threads)
+            assert errs == []
+            assert router.health()["scatters"] == 8
+        finally:
+            router.close()
+
+    def test_drift_rollup_labels_replicas_and_takes_fleet_max(self):
+        router, engines, _ = make_router(n_replicas=2, n_cities=4)
+        try:
+            engines[1].generation = 2  # fake drift scales with generation
+            roll = router.drift_rollup()
+            assert set(roll["replicas"]) == {"0", "1"}
+            assert roll["fleet"]["z_max"] == max(
+                v["z_max"] for v in roll["replicas"].values()
+            )
+        finally:
+            router.close()
+
+    def test_close_is_idempotent_and_closes_all(self):
+        router, engines, spares = make_router(n_replicas=2, n_cities=4,
+                                              spares=1)
+        router.close()
+        router.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not all(
+            e.closed for e in engines + spares
+        ):
+            time.sleep(0.01)
+        assert all(e.closed for e in engines + spares)
+
+
+class TestFederationFaultPlan:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            FederationFaultSpec(kind="nope")
+        with pytest.raises(ValueError, match="replica-kill"):
+            FederationFaultSpec(kind="replica-kill", replica=1)
+        with pytest.raises(ValueError, match="hang_ms"):
+            FederationFaultSpec(kind="hang-on-drain", replica=1)
+        with pytest.raises(ValueError, match="herd-spike"):
+            FederationFaultSpec(kind="herd-spike", city=0, dispatch=1)
+
+    def test_empty_plan_short_circuits(self):
+        plan = FederationFaultPlan()
+        assert not plan.active
+        assert plan.kill_at_scatter(0) is None
+        assert plan.herd_burst(0) == []
+        assert plan.poison_candidate("/nonexistent/candidate-0.ckpt") is False
+
+    def test_herd_burst_is_one_shot(self):
+        plan = FederationFaultPlan(
+            FederationFaultSpec(kind="herd-spike", city=3, dispatch=5,
+                                burst=10)
+        )
+        assert plan.herd_burst(4) == []
+        assert plan.herd_burst(5) == [(3, 10)]
+        assert plan.herd_burst(5) == []
+
+    def test_poison_flips_one_byte_once(self, tmp_path):
+        p = tmp_path / "candidate-0.ckpt"
+        p.write_bytes(b"abcdef")
+        plan = FederationFaultPlan(
+            FederationFaultSpec(kind="poisoned-candidate")
+        )
+        assert plan.poison_candidate(str(p)) is True
+        assert p.read_bytes() != b"abcdef"
+        assert plan.poison_candidate(str(p)) is False  # one-shot
+        other = tmp_path / "best.ckpt"
+        other.write_bytes(b"abcdef")
+        plan2 = FederationFaultPlan(
+            FederationFaultSpec(kind="poisoned-candidate")
+        )
+        assert plan2.poison_candidate(str(other)) is False  # glob mismatch
+
+
+class TestTierPromotionGate:
+    """Quarantine-once / cutover-everywhere, on fake watchers + real
+    candidate files (the integrity check reads real bytes)."""
+
+    def _gate(self, tmp_path, n_replicas=3, watcher_fails_on=(),
+              fault_plan=None):
+        engines = [
+            FakeEngine(watcher_fails=(i in watcher_fails_on))
+            for i in range(n_replicas)
+        ]
+        cfg = FederationConfig(enabled=True, replicas=n_replicas)
+        router = FederationRouter(
+            engines, range(2 * n_replicas), config=cfg, fault_plan=fault_plan,
+        )
+        gate = TierPromotionGate(router, str(tmp_path / "watch"))
+        return gate, router, engines
+
+    def _candidate(self, tmp_path, name="candidate-0.ckpt"):
+        from stmgcn_tpu.train.checkpoint import save_checkpoint
+
+        path = str(tmp_path / name)
+        save_checkpoint(path, {"w": np.ones((2,), np.float32)}, {}, {})
+        return path
+
+    CLEAN = {"nonfinite": 0, "grad_norm_max": 1.0, "update_ratio_max": 0.01}
+
+    def test_promotion_cuts_over_every_replica_once(self, tmp_path):
+        gate, router, engines = self._gate(tmp_path)
+        try:
+            path = self._candidate(tmp_path)
+            decision = gate.consider(path, self.CLEAN)
+            assert decision.accepted and decision.reason == "promoted"
+            assert [e.generation for e in engines] == [1, 1, 1]
+            assert [w.polls for w in gate.watchers.values()] == [1, 1, 1]
+            assert decision.checks["tier"]["swapped"] == [0, 1, 2]
+            assert os.path.exists(os.path.join(gate.out_dir, "latest.ckpt"))
+        finally:
+            router.close()
+
+    def test_poisoned_candidate_quarantined_once_not_m_times(self, tmp_path):
+        plan = FederationFaultPlan(
+            FederationFaultSpec(kind="poisoned-candidate")
+        )
+        gate, router, engines = self._gate(tmp_path, fault_plan=plan)
+        try:
+            path = self._candidate(tmp_path)
+            decision = gate.consider(path, self.CLEAN)
+            assert not decision.accepted
+            assert decision.reason == "corrupt"
+            # ONE quarantine for the tier: one rename, one count, and no
+            # replica ever saw the candidate
+            assert gate.rejections == 1
+            assert decision.path.endswith(".rejected-corrupt")
+            assert not os.path.exists(path)
+            assert [e.generation for e in engines] == [0, 0, 0]
+            assert [w.polls for w in gate.watchers.values()] == [0, 0, 0]
+        finally:
+            router.close()
+
+    def test_failed_cutover_detaches_replica_from_ring(self, tmp_path):
+        gate, router, engines = self._gate(tmp_path, watcher_fails_on={1})
+        try:
+            path = self._candidate(tmp_path)
+            decision = gate.consider(path, self.CLEAN)
+            assert decision.accepted
+            assert decision.checks["tier"]["failed"] == [1]
+            assert gate.detached == [1]
+            # the laggard left the ring: the active set stays generation-
+            # consistent and its cities re-homed to cut-over replicas
+            assert 1 not in router.assignment().values()
+            gens = {
+                e.generation for i, e in enumerate(engines) if i != 1
+            }
+            assert gens == {1}
+        finally:
+            router.close()
+
+
+class TestFederationConfigViolations:
+    """Boundary pins live in tests/test_analysis.py with the other
+    contract rules; here only the dataclass plumbing the router uses."""
+
+    def test_router_rejects_invalid_config(self):
+        cfg = FederationConfig(enabled=True, replicas=2,
+                               drain_timeout_s=1.0, handover_timeout_s=9.0)
+        with pytest.raises(ValueError, match="invalid federation config"):
+            FederationRouter([FakeEngine(), FakeEngine()], range(4),
+                             config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the real M-replica soak through the CLI, one JSON line out
+
+
+CLEAN_ENV = {
+    k: v for k, v in os.environ.items() if not k.startswith("STMGCN_")
+}
+
+
+@pytest.mark.slow
+class TestFederationSoakContract:
+    def test_serve_bench_federation_record_contract(self, tmp_path):
+        env = dict(
+            CLEAN_ENV, JAX_PLATFORMS="cpu",
+            STMGCN_BENCH_LOCK_PATH=str(tmp_path / "bench.lock"),
+        )
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "stmgcn_tpu.cli", "serve-bench",
+                "--rows", "3", "--batch", "4", "--buckets", "1,2,4",
+                "--clients", "4", "--per-client", "4", "--iters", "5",
+                "--warmup", "1", "--no-fleet", "--soak",
+                "--soak-seconds", "1.0", "--soak-overload", "2.0",
+                "--federation", "3",
+            ],
+            capture_output=True, text=True, timeout=1200, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, f"stdout must be ONE json line: {lines}"
+        record = json.loads(lines[0])
+
+        fed = record["federation"]
+        assert fed["config"]["replicas"] == 3
+        assert fed["config"]["cities"] >= fed["config"]["replicas"]
+        assert fed["config_findings"] == []
+
+        # the never-hang / never-mix tier contract, under real load
+        soak = fed["soak"]
+        assert soak["hung_clients"] == 0
+        assert soak["cross_generation"] == 0
+        assert soak["outcomes"]["ok"] > 0
+
+        # capacity is measured, not asserted: the record must carry the
+        # provenance to judge it (core count, host contention)
+        assert fed["capacity"]["tier_rps"] > 0
+        assert fed["capacity"]["n_cores"] >= 1
+        assert isinstance(fed["contended"], bool)
+
+        drills = fed["drills"]
+        assert drills["tier_rejection"]["reason"] == "corrupt"
+        assert drills["tier_rejection"]["rejections_counted"] == 1
+        assert drills["tier_rejection"]["generations_untouched"] is True
+        assert drills["replica_kill"]["kills"] == 1
+        assert drills["replica_kill"]["cities_moved"] >= 1
+        assert drills["herd"]["extra_ok"] + drills["herd"]["extra_shed"] > 0
+        assert drills["drain"]["flushed"] is True
+        assert drills["drain"]["watcher_wedged"] is False
+        assert drills["reshard_promote"]["handover_flushed"] is True
+        assert drills["reshard_promote"]["burst_cross_generation"] == 0
+
+        promo = fed["promotion"]
+        assert promo["mid_soak"]["accepted"] is True
+        gens = set(promo["generations_after"].values())
+        assert gens == {1}  # every live replica on the promoted generation
+
+        rec = fed["recovery"]
+        assert rec["cities_serveable"] == rec["cities_total"]
+        assert fed["budget"]["outstanding"] == 0

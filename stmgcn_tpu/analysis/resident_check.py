@@ -114,13 +114,18 @@ def check_resident_memory(
             # "auto" degrades to streaming when oversized; "stream" never
             # holds data resident — nothing can fail at runtime
             continue
-        if cfg.mesh.n_devices > 1:
+        if cfg.mesh.n_devices > 1 and cfg.train.window_free is False:
+            # mesh residency composes ONLY through the window-free gather
+            # (series region-sharded, index blocks dp-sharded — the
+            # composed multi-chip fast path); materialized windows on a
+            # mesh are rejected by the trainer
             emit(
                 name,
                 f"{name}: data_placement='resident' with a "
-                f"{cfg.mesh.n_devices}-device mesh — the trainer rejects "
-                "mesh-resident data (per-shard index translation is not "
-                "implemented); stream batches instead",
+                f"{cfg.mesh.n_devices}-device mesh and window_free=False "
+                "— the trainer rejects mesh-resident materialized windows "
+                "(residency composes only through the window-free "
+                "gather); drop window_free=False or stream batches",
             )
             continue
         est = estimate_resident_bytes(cfg)

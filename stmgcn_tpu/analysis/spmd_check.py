@@ -2,12 +2,16 @@
 
 Every other contract pass reasons *a priori* (config math, abstract
 traces). This one closes the loop the way ``pallas_check`` did for
-Mosaic VMEM: it lowers the **real sharded train/serve step programs**
-for each multi-device preset on the virtual CPU mesh (the same
-``--xla_force_host_platform_device_count`` substrate ``dryrun_multichip``
-and the 8-virtual-device tests use — no accelerator, no execution),
-walks the post-partitioning HLO for collectives (:mod:`.hlo`), and diffs
-what GSPMD actually emitted against the plan's declared
+Mosaic VMEM: it lowers the **real composed train/serve programs** —
+the fused superstep each preset's trainer actually dispatches
+(:meth:`~stmgcn_tpu.train.trainer.Trainer.composed_program`, built by
+:mod:`stmgcn_tpu.parallel.compose`) and the serving engines'
+``serve_bucket_fn`` over the same model/operands — on the virtual CPU
+mesh (the same ``--xla_force_host_platform_device_count`` substrate
+``dryrun_multichip`` and the 8-virtual-device tests use — no
+accelerator, no execution), walks the post-partitioning HLO for
+collectives (:mod:`.hlo`), and diffs what GSPMD actually emitted against
+the plan's declared
 :class:`~stmgcn_tpu.parallel.manifest.CollectiveManifest`. Three rules:
 
 - ``spmd-collective-manifest``: an observed collective with no matching
@@ -28,11 +32,17 @@ what GSPMD actually emitted against the plan's declared
   + batch shard per device vs the per-core budget) for every
   multi-device preset — the rule extension ROADMAP item 3 asks for.
 
-The probe programs shrink data/model dims (dryrun-style) so lowering
-stays in CPU-compile seconds, but keep each preset's mesh axes and
-routing decisions — the manifest's vocabulary (collective kind x mesh
-axes) is shrink-invariant. Lowerings are cached per program: all three
-rules and the lint-gate summary read one compile.
+The composed trainers shrink data/model dims (dryrun-style,
+:func:`stmgcn_tpu.parallel.compose.composed_config`) so lowering stays
+in CPU-compile seconds, but keep each preset's mesh axes and routing
+decisions — the manifest's vocabulary (collective kind x mesh axes) is
+shrink-invariant. Crucially these are NOT standalone probe programs:
+``scripts/lint_gate.sh`` executes one smoke superstep of the same
+composed program and ``tests/test_multichip_exec.py`` pins its parity
+against the single-device/per-step twin, so the certified program and
+the executed program are one object by construction. Lowerings are
+cached per program: all three rules and the lint-gate summary read one
+compile.
 """
 
 from __future__ import annotations
@@ -62,12 +72,13 @@ __all__ = [
 #: compiled module), measured x ~2 headroom, rounded up to the next KiB.
 #: Single-line literal: ``stmgcn lint --rebaseline`` rewrites it in place
 #: from fresh measurements (:func:`rebaseline_wire`).
-WIRE_BUDGETS = {"multicity/train": 8192, "multicity/serve": 1024, "scaled/train": 60416, "scaled/serve": 27648, "branchpar/train": 6144, "branchpar/serve": 2048, "bandedbranch/train": 15360, "bandedbranch/serve": 4096}
+WIRE_BUDGETS = {"multicity/train": 16384, "multicity/serve": 1024, "scaled/train": 113664, "scaled/serve": 55296, "branchpar/train": 8192, "branchpar/serve": 2048, "bandedbranch/train": 15360, "bandedbranch/serve": 4096}
 
-#: probe program registry: name -> (preset, "train"|"serve", banded?).
+#: composed program registry: name -> (preset, "train"|"serve", banded?).
 #: Every preset whose mesh spans >1 device must appear here (coverage is
 #: itself checked); ``banded`` marks programs whose routing must engage
-#: the explicit halo plan, which flips the manifest's required ops.
+#: the explicit halo plan, which flips the manifest's required ops. The
+#: preset names index :data:`stmgcn_tpu.parallel.compose.COMPOSED_PRESETS`.
 PROGRAM_SPECS = {
     "multicity/train": ("multicity", "train", False),
     "multicity/serve": ("multicity", "serve", False),
@@ -79,13 +90,13 @@ PROGRAM_SPECS = {
     "bandedbranch/serve": ("bandedbranch", "serve", True),
 }
 
-_ITEMSIZE = 4  # probe programs run float32 (dryrun parity)
+_ITEMSIZE = 4  # composed programs run float32 (dryrun parity)
 _PSUM_SLACK_BYTES = 4096  # loss/count scalars riding the dp sync
 
 
 @dataclasses.dataclass
 class ProgramReport:
-    """One lowered probe program: compiled collectives + wire meta."""
+    """One lowered composed program: compiled collectives + wire meta."""
 
     name: str
     ops: List[CollectiveOp]
@@ -102,7 +113,7 @@ class ProgramReport:
 
 
 def declared_manifests() -> Dict[str, "object"]:
-    """Every probe program's declared manifest — pure config, no JAX.
+    """Every composed program's declared manifest — pure config, no JAX.
 
     This is what ``dryrun_multichip`` persists into the ``MULTICHIP_r*``
     record so future on-chip runs can diff compiled reality against the
@@ -118,52 +129,11 @@ def declared_manifests() -> Dict[str, "object"]:
 
 
 # ---------------------------------------------------------------------------
-# probe program construction (cached; one lowering per program, shared by
+# composed program lowering (cached; one lowering per program, shared by
 # every rule and by the lint-gate summary)
 # ---------------------------------------------------------------------------
 
 _REPORT_CACHE: Optional[Dict[str, ProgramReport]] = None
-
-
-def _band_adj(n: int, w: int, seed: int):
-    """Symmetric adjacency with every edge within index distance ``w``."""
-    import numpy as np
-
-    rng = np.random.default_rng(seed)
-    a = np.zeros((n, n), np.float32)
-    for d in range(1, w + 1):
-        band = (rng.random(n - d) < 0.7).astype(np.float32)
-        a += np.diag(band, d) + np.diag(band, -d)
-    return a
-
-
-def _abstract_state(tree, mesh):
-    """ShapeDtypeStructs with the state placement's shardings attached.
-
-    Mirrors :meth:`MeshPlacement.put(kind="state")` — replicated except
-    the vmapped ``branches`` subtree's leading axis over ``branch`` —
-    without materializing a single parameter: the probe only lowers.
-    """
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.tree_util import DictKey, tree_map_with_path
-
-    has_branch = "branch" in mesh.shape
-
-    def conv(path, leaf):
-        in_branches = has_branch and any(
-            isinstance(k, DictKey) and k.key == "branches" for k in path
-        )
-        spec = (
-            P("branch", *([None] * (len(leaf.shape) - 1)))
-            if in_branches and len(leaf.shape)
-            else P()
-        )
-        return jax.ShapeDtypeStruct(
-            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
-        )
-
-    return tree_map_with_path(conv, tree)
 
 
 def _tree_bytes(tree) -> int:
@@ -174,152 +144,66 @@ def _tree_bytes(tree) -> int:
     )
 
 
-def _lower_pair(
-    base: str, mesh, placement, model, supports, x, y, mask, meta: dict
-) -> Dict[str, ProgramReport]:
-    """Lower ``{base}/train`` and ``{base}/serve`` from abstract params."""
+def _composed_pair(base: str) -> Dict[str, ProgramReport]:
+    """Lower ``{base}/train`` and ``{base}/serve`` from the preset's
+    composed trainer (:mod:`stmgcn_tpu.parallel.compose`).
+
+    The train program is the fused superstep
+    :meth:`~stmgcn_tpu.train.trainer.Trainer.composed_program` returns —
+    the very jitted callable the trainer's epochs dispatch, with its real
+    placed operand tuple. The serve program is the serving engines'
+    ``serve_bucket_fn`` over the same model/params/supports, fed a window
+    gathered from the resident series (so its batch/node shardings are
+    the trainer's, not a probe's).
+    """
     import jax
-    import numpy as np
 
+    from stmgcn_tpu.parallel.compose import (
+        banded_meta, composed_config, composed_trainer,
+    )
     from stmgcn_tpu.serving.engine import serve_bucket_fn
-    from stmgcn_tpu.train import make_optimizer, make_step_fns
 
-    sup_p = placement.put(supports, "supports")
-    x_p = placement.put(np.asarray(x), "x")
-    y_p = placement.put(np.asarray(y), "y")
-    mask_p = placement.put(np.asarray(mask), "mask")
-    fns = make_step_fns(model, make_optimizer(2e-3, 1e-4), "mse")
-    params_s, opt_s = jax.eval_shape(fns.init, jax.random.key(0), sup_p, x_p)
-    params_a = _abstract_state(params_s, mesh)
-    opt_a = _abstract_state(opt_s, mesh)
-    meta = dict(meta, param_bytes=_tree_bytes(params_s))
-
+    cfg = composed_config(base)
+    trainer = composed_trainer(base)
+    pname, fn, args = trainer.composed_program()
+    meta = dict(
+        banded_meta(trainer, cfg),
+        param_bytes=_tree_bytes(trainer.params),
+        program=pname,
+    )
+    mesh = trainer.placement.mesh
     shape = tuple(mesh.devices.shape)
     names = tuple(mesh.axis_names)
     out: Dict[str, ProgramReport] = {}
 
-    txt = (
-        fns.train_step.lower(params_a, opt_a, sup_p, x_p, y_p, mask_p)
-        .compile()
-        .as_text()
-    )
+    txt = fn.lower(*args).compile().as_text()
     ops, loops = collect_collectives(txt, shape, names)
     out[f"{base}/train"] = ProgramReport(
         f"{base}/train", ops, loops, shape, names, meta
     )
 
+    batch = next(trainer.dataset.batches(
+        "train", trainer.batch_size, pad_last=True, with_arrays=False,
+    ))
+    x, _, _ = trainer._place_batch(batch, "train")
     # bind the factory result first: serve_bucket_fn itself is never the
     # jitted callable, so it must not become a program-db jit root here
-    serve_fwd = serve_bucket_fn(model)
+    serve_fwd = serve_bucket_fn(trainer.model)
     serve = jax.jit(serve_fwd)
-    txt = serve.lower(params_a, sup_p, x_p).compile().as_text()
+    txt = (
+        serve.lower(trainer.params, trainer._supports_for(batch), x)
+        .compile()
+        .as_text()
+    )
     ops, loops = collect_collectives(txt, shape, names)
     out[f"{base}/serve"] = ProgramReport(
-        f"{base}/serve", ops, loops, shape, names, meta
+        f"{base}/serve", ops, loops, shape, names, dict(meta, program="serve_bucket")
     )
     return out
 
 
-def _probe_dense(base: str, dp: int, branch: int, M: int) -> Dict[str, ProgramReport]:
-    """Dense-GSPMD probe (dp and dp x branch plans): no region sharding,
-    tiny synthetic operands — support values are irrelevant to the
-    lowered communication structure."""
-    import numpy as np
-
-    from stmgcn_tpu.models import STMGCN
-    from stmgcn_tpu.parallel import MeshPlacement, build_mesh
-
-    rng = np.random.default_rng(0)
-    N, B, T = 16, 2 * dp, 3
-    mesh = build_mesh(dp=dp, region=1, branch=branch)
-    placement = MeshPlacement(mesh)
-    model = STMGCN(
-        m_graphs=M, n_supports=2, seq_len=T, input_dim=1,
-        lstm_hidden_dim=8, lstm_num_layers=1, gcn_hidden_dim=8,
-    )
-    sup = rng.normal(size=(M, 2, N, N)).astype(np.float32) * 0.1
-    x = rng.standard_normal((B, T, N, 1)).astype(np.float32)
-    y = (rng.standard_normal((B, N, 1)) * 0.1).astype(np.float32)
-    mask = np.ones(B, np.float32)
-    return _lower_pair(base, mesh, placement, model, sup, x, y, mask, {})
-
-
-def _probe_routed(base: str) -> Dict[str, ProgramReport]:
-    """Banded probes through the *real* routing path: ``build_dataset``
-    + ``route_supports`` + ``build_model``, dryrun-style shrinks.
-
-    ``scaled``: 32x2 grid so the cheb-K2 grid branch fits the halo
-    budget (bandwidth 4 <= n_local // 2 = 4) while the random transport/
-    similarity branches rightly stay dense — the preset's mixed plan.
-    ``bandedbranch``: banded city adjacencies stand in for the synthetic
-    transport graph (which no ordering bands — see the preset docstring);
-    with every branch within budget, routing produces the branch-stacked
-    strips whose engaged composition the manifest declares.
-    """
-    import numpy as np
-
-    from stmgcn_tpu.config import preset
-    from stmgcn_tpu.experiment import build_dataset, build_model, route_supports
-    from stmgcn_tpu.parallel import MeshPlacement, ShardSpec, build_mesh
-
-    cfg = preset(base)
-    cfg.model.lstm_hidden_dim = 8
-    cfg.model.lstm_num_layers = 1
-    cfg.model.gcn_hidden_dim = 8
-    cfg.model.dtype = "float32"
-    if base == "scaled":
-        # 32x2 grid, cheb-K2: grid bandwidth K*cols = 4 <= n_local//2 = 4
-        # (the 50x50/K=3 original routes the same way at preset scale)
-        cfg.data.rows, cfg.data.cols = 32, 2
-        cfg.data.n_timesteps = 24 * 7 + 64
-        cfg.model.K = 2
-        cfg.train.batch_size = 2
-    else:  # bandedbranch
-        cfg.data.rows = 4
-        cfg.data.n_timesteps = 24 * 7 + 64
-        cfg.train.batch_size = 4
-        cfg.mesh.halo = 4
-    mesh = build_mesh(
-        dp=cfg.mesh.dp, region=cfg.mesh.region, branch=cfg.mesh.branch
-    )
-    placement = MeshPlacement(mesh)
-    dataset = build_dataset(cfg)
-    if base == "bandedbranch":
-        n = dataset.n_nodes
-        dataset.adjs = {"g0": _band_adj(n, 1, 1), "g1": _band_adj(n, 2, 2)}
-    supports, modes = route_supports(cfg, dataset)
-    if modes is None or "banded" not in modes:
-        raise RuntimeError(
-            f"spmd probe {base!r}: routing did not engage the banded plan "
-            f"(modes={modes}) — the probe shrink no longer matches the "
-            "router's bandwidth budget"
-        )
-    model = build_model(cfg, dataset.n_feats, modes, ShardSpec(mesh=mesh))
-    batch = next(
-        dataset.batches("train", cfg.train.batch_size, pad_last=True)
-    )
-    mask = (np.arange(len(batch)) < batch.n_real).astype(np.float32)
-    banded = [s for s in (supports if isinstance(supports, tuple) else (supports,))
-              if hasattr(s, "halo")]
-    halo = max(s.halo for s in banded)
-    m_local = max(1, cfg.model.m_graphs // cfg.mesh.branch)
-    f_cap = (
-        cfg.data.serial_len + cfg.data.daily_len + cfg.data.weekly_len
-        + 2 * cfg.model.lstm_hidden_dim + cfg.model.gcn_hidden_dim
-    )
-    meta = {
-        "halo": halo,
-        "b_local": cfg.train.batch_size // cfg.mesh.dp,
-        "m_local": m_local,
-        "f_cap": f_cap,
-    }
-    return _lower_pair(
-        base, mesh, placement, model, supports, batch.x, batch.y, mask, meta
-    )
-
-
 def _lower_programs() -> Dict[str, ProgramReport]:
-    """All probe programs, lowered once per process and cached."""
+    """All composed programs, lowered once per process and cached."""
     global _REPORT_CACHE
     if _REPORT_CACHE is not None:
         return _REPORT_CACHE
@@ -330,19 +214,19 @@ def _lower_programs() -> Dict[str, ProgramReport]:
     )
     if len(jax.devices()) < need:
         raise RuntimeError(
-            f"spmd contract pass needs {need} devices to lower the probe "
-            f"programs, found {len(jax.devices())} — call "
+            f"spmd contract pass needs {need} devices to lower the "
+            f"composed programs, found {len(jax.devices())} — call "
             "force_host_platform('cpu', n_devices=8) before any JAX use "
             "(stmgcn lint and tests/conftest.py do)"
         )
     reports: Dict[str, ProgramReport] = {}
-    reports.update(_probe_dense("multicity", dp=8, branch=1, M=2))
-    reports.update(_probe_routed("scaled"))
-    reports.update(_probe_dense("branchpar", dp=2, branch=3, M=3))
-    reports.update(_probe_routed("bandedbranch"))
+    for preset_name in dict.fromkeys(p for p, _, _ in PROGRAM_SPECS.values()):
+        reports.update(_composed_pair(preset_name))
     missing = set(PROGRAM_SPECS) - set(reports)
     if missing:
-        raise RuntimeError(f"spmd probes built no program for {sorted(missing)}")
+        raise RuntimeError(
+            f"composed lowering built no program for {sorted(missing)}"
+        )
     _REPORT_CACHE = reports
     return reports
 
@@ -589,8 +473,9 @@ def check_shard_footprints(
 def check_spmd_contracts(
     budgets: Optional[Dict[str, int]] = None,
 ) -> List[Finding]:
-    """The full pass: coverage + manifest + wire for every probe program,
-    then preset-scale footprints. One (cached) lowering per program."""
+    """The full pass: coverage + manifest + wire for every composed
+    program, then preset-scale footprints. One (cached) lowering per
+    program."""
     from stmgcn_tpu.config import PRESETS
 
     budgets = WIRE_BUDGETS if budgets is None else budgets
@@ -600,9 +485,10 @@ def check_spmd_contracts(
         if build().mesh.n_devices > 1 and name not in covered:
             _emit(
                 findings, "spmd-collective-manifest", name,
-                f"{name}: multi-device preset has no spmd probe program — "
-                "add it to analysis/spmd_check.PROGRAM_SPECS so its "
-                "compiled collectives are checked against a manifest",
+                f"{name}: multi-device preset has no composed spmd "
+                "program — add it to analysis/spmd_check.PROGRAM_SPECS "
+                "(and parallel/compose.py) so its compiled collectives "
+                "are checked against a manifest",
             )
     manifests = declared_manifests()
     for name, rep in _lower_programs().items():

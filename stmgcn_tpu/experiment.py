@@ -409,6 +409,7 @@ def build_trainer(
     placement=None,
     verbose: bool = True,
     fault_plan=None,
+    dataset=None,
 ) -> Trainer:
     """Assemble a trainer; a >1-device mesh config gets sharded placement.
 
@@ -419,13 +420,18 @@ def build_trainer(
     ``fault_plan`` (a :class:`~stmgcn_tpu.resilience.FaultPlan`) threads
     deterministic fault injection through the trainer's hot loop — the
     fault-drill tests' entry point; ``None`` is the no-op production plan.
+
+    ``dataset`` overrides the config-built dataset (same config, edited
+    data — e.g. :mod:`~stmgcn_tpu.parallel.compose` swaps in banded
+    adjacencies before routing); ``None`` builds from ``cfg``.
     """
     if placement is None and cfg.mesh.n_devices > 1:
         # Fail fast (before data/support construction) if the mesh can't exist.
         from stmgcn_tpu.parallel import MeshPlacement, mesh_from_config
 
         placement = MeshPlacement(mesh_from_config(cfg.mesh))
-    dataset = build_dataset(cfg)
+    if dataset is None:
+        dataset = build_dataset(cfg)
     supports, support_modes = route_supports(cfg, dataset)
     shard_spec = None
     if support_modes is not None and {"banded", "sparse"} & set(support_modes):

@@ -36,6 +36,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from stmgcn_tpu.ops.spmm import (
     TILE,
@@ -46,12 +47,15 @@ from stmgcn_tpu.ops.spmm import (
 )
 
 __all__ = [
+    "ShardedTiledBranch",
     "TiledBranchSupports",
     "TiledSupports",
     "gathered_tiles_apply",
     "gathered_tiles_apply_reference",
     "plan_tiling",
     "rcm_permutation",
+    "shard_tiled_plan",
+    "sharded_gathered_tiles_apply",
 ]
 
 
@@ -456,3 +460,217 @@ def gathered_tiles_apply_reference(
     return _gathered_tiles_fwd_call(
         branch.data, branch.idx, x_mat, branch.n, branch.tile
     )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ShardedTiledBranch:
+    """One branch's tiled plan split along the permuted block-row axis.
+
+    The RCM permutation that makes blocks dense also makes them *banded*:
+    a permuted support's kept blocks sit within a bounded block distance
+    of the diagonal, so splitting the block-row axis into contiguous
+    shards needs only a ``halo``-block boundary exchange per shard — the
+    tiled analogue of :mod:`stmgcn_tpu.parallel.banded`'s strips, riding
+    the same ring :func:`~stmgcn_tpu.parallel.halo.halo_exchange`.
+
+    ``data``/``idx`` lead with the shard axis (placed over ``region``,
+    exactly like :class:`~stmgcn_tpu.parallel.sparse.ShardedBlockSparse`);
+    ``idx`` is **halo-local**: global block column ``j`` of shard ``s``
+    is stored as ``j - s*r_loc + halo``, clamped into the halo-extended
+    range for the padded zero-data blocks (index 0 with zero data — the
+    clamp lands them on a real block whose contribution is zero).
+    ``data_t``/``idx_t`` are the prepared-transpose stacks, sharded the
+    same way at their own ``halo_t``.
+    """
+
+    data: jnp.ndarray  # (S, K, R_loc, C, tile, tile) f32
+    idx: jnp.ndarray  # (S, K, R_loc, C) int32, halo-local
+    data_t: jnp.ndarray  # (S, K, R_loc, C_t, tile, tile) f32
+    idx_t: jnp.ndarray  # (S, K, R_loc, C_t) int32, halo-local
+    halo: int
+    halo_t: int
+    n: int
+    tile: int
+
+    def tree_flatten(self):
+        return (self.data, self.idx, self.data_t, self.idx_t), (
+            self.halo, self.halo_t, self.n, self.tile,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, idx, data_t, idx_t = children
+        halo, halo_t, n, tile = aux
+        return cls(data=data, idx=idx, data_t=data_t, idx_t=idx_t,
+                   halo=halo, halo_t=halo_t, n=n, tile=tile)
+
+    @property
+    def n_shards(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def n_supports(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def block_rows_local(self) -> int:
+        return self.data.shape[2]
+
+
+def _block_halo(data: np.ndarray, idx: np.ndarray) -> int:
+    """Max block distance |column - row| over truly-nonzero blocks —
+    the boundary depth a contiguous block-row shard must import. Padded
+    zero-data blocks don't count (their index is the harmless 0)."""
+    nz = np.any(data != 0.0, axis=(-1, -2))  # (K, R, C)
+    rows = np.arange(idx.shape[1], dtype=np.int64)[None, :, None]
+    dist = np.abs(idx.astype(np.int64) - rows)
+    return int(dist[nz].max(initial=0))
+
+
+def shard_tiled_plan(
+    branch: TiledBranchSupports, n_shards: int
+) -> ShardedTiledBranch:
+    """Split one branch's tiled plan into ``n_shards`` contiguous
+    block-row shards with halo-local column indices (host-side numpy —
+    the same offline character as :func:`plan_tiling`).
+
+    Raises when the block rows don't divide ``n_shards`` (pad the plan
+    with :meth:`TiledSupports.pad_to` first) or when the plan's block
+    bandwidth exceeds a shard's rows (the halo exchange only reaches the
+    ring neighbors — re-tile coarser or shard less).
+    """
+    data = np.asarray(branch.data)
+    idx = np.asarray(branch.idx)
+    data_t = np.asarray(branch.data_t)
+    idx_t = np.asarray(branch.idx_t)
+    r = idx.shape[1]
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if r % n_shards:
+        raise ValueError(
+            f"{r} block rows not divisible by n_shards={n_shards} — "
+            "pad_to a divisible rung first"
+        )
+    r_loc = r // n_shards
+    # halo_exchange needs halo >= 1 and <= r_loc
+    halo = max(_block_halo(data, idx), 1)
+    halo_t = max(_block_halo(data_t, idx_t), 1)
+    over = max(halo, halo_t)
+    if over > r_loc:
+        raise ValueError(
+            f"block bandwidth {over} exceeds the {r_loc} block rows per "
+            f"shard at n_shards={n_shards} — the ring halo exchange only "
+            "reaches adjacent shards; use fewer shards or a larger tile"
+        )
+
+    def split(d, i, h):
+        ds = np.stack([d[:, s * r_loc:(s + 1) * r_loc] for s in range(n_shards)])
+        loc = np.stack([
+            i[:, s * r_loc:(s + 1) * r_loc].astype(np.int64) - s * r_loc + h
+            for s in range(n_shards)
+        ])
+        return ds, np.clip(loc, 0, r_loc + 2 * h - 1).astype(np.int32)
+
+    data_s, idx_s = split(data, idx, halo)
+    data_ts, idx_ts = split(data_t, idx_t, halo_t)
+    return ShardedTiledBranch(
+        data=jnp.asarray(data_s), idx=jnp.asarray(idx_s),
+        data_t=jnp.asarray(data_ts), idx_t=jnp.asarray(idx_ts),
+        halo=halo, halo_t=halo_t, n=branch.n, tile=branch.tile,
+    )
+
+
+def sharded_gathered_tiles_apply(
+    mesh,
+    sharded: ShardedTiledBranch,
+    x_mat: jnp.ndarray,
+    axis_name: str = "region",
+) -> jnp.ndarray:
+    """:func:`gathered_tiles_apply` with the block rows sharded over
+    ``axis_name``: each shard halo-exchanges ``halo`` boundary signal
+    blocks with its ring neighbors, gathers by its halo-local indices,
+    and contracts its own tiles — no full-node all-gather. ``x_mat`` is
+    the *permuted* ``(N, BF)`` signal; returns ``(K, N, BF)`` f32.
+
+    The prepared backward mirrors the forward over the sharded
+    pre-transposed stacks (``dx = sum_k A_k^T @ g_k`` at ``halo_t``),
+    so the custom VJP keeps the no-scatter property of the single-device
+    path on the mesh.
+    """
+    from stmgcn_tpu.parallel.halo import halo_exchange
+    from stmgcn_tpu.utils.platform import shard_map
+
+    data, idx = sharded.data, sharded.idx
+    data_t, idx_t = sharded.data_t, sharded.idx_t
+    n, tile = sharded.n, sharded.tile
+    halo, halo_t = sharded.halo, sharded.halo_t
+    r = sharded.n_shards * sharded.block_rows_local
+    n_pad = r * tile
+    x_dtype = x_mat.dtype
+    bf = x_mat.shape[1]
+
+    def local_fwd(d, i, x_blocks):
+        # d: (1, K, r_loc, C, t, t); x_blocks: (r_loc, t, BF)
+        xb = halo_exchange(x_blocks, halo, axis_name)
+        gathered = jnp.take(xb, i[0], axis=0, mode="clip")  # (K, r_loc, C, t, BF)
+        return jnp.einsum(
+            "krcij,krcjf->krif", d[0], gathered,
+            preferred_element_type=jnp.float32,
+        )  # (K, r_loc, t, BF)
+
+    def local_bwd(dt, it, g_blocks):
+        # g_blocks: (r_loc, K, t, BF) — block rows lead for the exchange
+        gb = halo_exchange(g_blocks, halo_t, axis_name)
+        gb = gb.transpose(1, 0, 2, 3)  # (K, r_loc + 2h, t, BF)
+        gathered = jax.vmap(
+            lambda blocks, ii: jnp.take(blocks, ii, axis=0, mode="clip")
+        )(gb, it[0])  # (K, r_loc, C_t, t, BF)
+        return jnp.einsum(
+            "krcij,krcjf->rif", dt[0], gathered,
+            preferred_element_type=jnp.float32,
+        )  # (r_loc, t, BF)
+
+    fwd_sharded = shard_map(
+        local_fwd,
+        mesh=mesh,
+        in_specs=(
+            P(axis_name, None, None, None, None, None),
+            P(axis_name, None, None, None),
+            P(axis_name, None, None),
+        ),
+        out_specs=P(None, axis_name, None, None),
+        check_vma=False,
+    )
+    bwd_sharded = shard_map(
+        local_bwd,
+        mesh=mesh,
+        in_specs=(
+            P(axis_name, None, None, None, None, None),
+            P(axis_name, None, None, None),
+            P(axis_name, None, None, None),
+        ),
+        out_specs=P(axis_name, None, None),
+        check_vma=False,
+    )
+
+    def fwd_call(x):
+        x_pad = jnp.pad(x, ((0, n_pad - x.shape[0]), (0, 0)))
+        out = fwd_sharded(data, idx, x_pad.reshape(r, tile, bf))
+        return out.reshape(-1, n_pad, bf)[:, :n]
+
+    @jax.custom_vjp
+    def _apply(x):
+        return fwd_call(x)
+
+    def _fwd(x):
+        return fwd_call(x), None
+
+    def _bwd(_res, g):
+        g_pad = jnp.pad(g, ((0, 0), (0, n_pad - g.shape[1]), (0, 0)))
+        g_blocks = g_pad.reshape(-1, r, tile, bf).transpose(1, 0, 2, 3)
+        dx = bwd_sharded(data_t, idx_t, g_blocks).reshape(n_pad, bf)[:n]
+        return (dx.astype(x_dtype),)
+
+    _apply.defvjp(_fwd, _bwd)
+    return _apply(x_mat)

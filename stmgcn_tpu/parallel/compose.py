@@ -1,0 +1,258 @@
+"""Composed multi-chip programs: one shrunk trainer per mesh preset.
+
+The trainer's fused superstep programs and the mesh presets used to live
+in different worlds — ``analysis/spmd_check`` certified probe programs it
+built itself, while the trainer executed unsharded twins. This module is
+the splice point: for every multi-device preset it builds a dryrun-scale
+trainer through the REAL assembly path (``build_dataset`` →
+``route_supports`` → ``build_model`` → ``Trainer``) whose fused
+window-free superstep engages on the preset's mesh, so
+
+- :mod:`stmgcn_tpu.analysis.spmd_check` lowers
+  :meth:`~stmgcn_tpu.train.trainer.Trainer.composed_program` for the
+  static SPMD audit (the audited program IS the executed program),
+- ``scripts/lint_gate.sh``'s ``spmd_exec`` section executes one smoke
+  superstep of the same program on the 8-virtual-device substrate,
+- ``bench.py``'s ``multichip`` leg and ``dryrun_multichip`` time/parity
+  the same program against its single-device (or per-step) twin.
+
+Shrinks keep each preset's mesh axes and routing decisions — the
+collective vocabulary (kind x mesh axes) is shrink-invariant — while
+fitting CPU-compile seconds:
+
+========== ================== =========================================
+preset      mesh               composed program
+========== ================== =========================================
+multicity   dp=8               ``fleet_superstep`` (hetero city pair)
+scaled      region=8 (auto)    ``series_superstep``, mixed banded/dense
+branchpar   dp=2 x branch=3    ``series_superstep``, branch-sharded
+bandedbranch dp=2 x region=2    ``series_superstep``, branch-stacked
+            x branch=2          banded strips (injected banded adjs)
+========== ================== =========================================
+
+Parity twins: dense presets (``multicity``/``branchpar``) have a true
+single-device twin — same config with the mesh removed, identical param
+init (the vmapped layout does not depend on mesh extents). The banded
+presets' layout/routing *is* a function of the mesh config, so their
+twin is the per-step loop on the SAME mesh (``steps_per_superstep=1``)
+— fusion parity rather than device-count parity; dp device-count parity
+is covered by the dense presets.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "COMPOSED_PRESETS",
+    "banded_meta",
+    "composed_config",
+    "composed_program_names",
+    "composed_trainer",
+    "parity_twin_kind",
+]
+
+#: every multi-device preset with a composed program (must stay in sync
+#: with ``analysis/spmd_check.PROGRAM_SPECS`` — coverage is checked there)
+COMPOSED_PRESETS = ("multicity", "scaled", "branchpar", "bandedbranch")
+
+#: twin kind per preset: "single" = true 1-device twin (same layout),
+#: "per_step" = per-step loop on the same mesh (banded layouts are
+#: mesh-config-derived, so removing the mesh changes the param tree)
+_TWIN = {
+    "multicity": "single",
+    "scaled": "per_step",
+    "branchpar": "single",
+    "bandedbranch": "per_step",
+}
+
+
+def _band_adj(n: int, w: int, seed: int):
+    """Symmetric adjacency with every edge within index distance ``w``."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    a = np.zeros((n, n), np.float32)
+    for d in range(1, w + 1):
+        band = (rng.random(n - d) < 0.7).astype(np.float32)
+        a += np.diag(band, d) + np.diag(band, -d)
+    return a
+
+
+def _shrink_model(cfg) -> None:
+    cfg.model.lstm_hidden_dim = 8
+    cfg.model.lstm_num_layers = 1
+    cfg.model.gcn_hidden_dim = 8
+    # float32 throughout: the wire budgets and the dp-psum/halo analytic
+    # models assume 4-byte elements (spmd_check._ITEMSIZE), and parity
+    # twins compare loss histories at f32 resolution
+    cfg.model.dtype = "float32"
+
+
+def composed_config(name: str):
+    """The preset's dryrun-scale config whose fused path engages on the
+    preset's mesh. Mesh axes and routing strategy are the preset's own;
+    data/model dims shrink; the window-free resident superstep is opted
+    in explicitly (``data_placement="resident"``, ``window_free=True``,
+    ``steps_per_superstep=2``)."""
+    from stmgcn_tpu.config import preset
+
+    if name not in COMPOSED_PRESETS:
+        raise ValueError(
+            f"no composed program for preset {name!r}; "
+            f"known: {COMPOSED_PRESETS}"
+        )
+    cfg = preset(name)
+    _shrink_model(cfg)
+    cfg.train.epochs = 2
+    cfg.train.steps_per_superstep = 2
+    cfg.train.window_free = True
+    cfg.train.data_placement = "resident"
+    if name == "multicity":
+        # hetero city pair, both cities in one fleet shape class (rows
+        # 4/3 both rung-pad to 16 nodes); batch 16 = dp x 2
+        cfg.data.rows = 4
+        cfg.data.city_rows = (4, 3)
+        cfg.data.n_timesteps = 24 * 7 * 2 + 40
+        cfg.data.city_timesteps = (24 * 7 * 2 + 40, 24 * 7 * 2 + 30)
+        cfg.train.batch_size = 16
+    elif name == "scaled":
+        # 32x2 grid, cheb-K2: grid bandwidth K*cols = 4 <= n_local//2 = 4
+        # (the 50x50/K=3 original routes the same way at preset scale);
+        # the random transport/similarity branches rightly stay dense —
+        # the preset's mixed banded/dense plan
+        cfg.data.rows, cfg.data.cols = 32, 2
+        cfg.data.n_timesteps = 24 * 7 + 64
+        cfg.model.K = 2
+        cfg.train.batch_size = 4
+    elif name == "branchpar":
+        cfg.data.rows = 4
+        cfg.data.n_timesteps = 24 * 7 + 64
+        cfg.train.batch_size = 4
+    else:  # bandedbranch
+        cfg.data.rows = 4
+        cfg.data.n_timesteps = 24 * 7 + 64
+        cfg.train.batch_size = 4
+        cfg.mesh.halo = 4
+    return cfg
+
+
+def parity_twin_kind(name: str) -> str:
+    return _TWIN[name]
+
+
+def composed_program_names() -> dict:
+    """``preset -> {"train": ..., "serve": ...}`` — which fused program
+    each preset's composed trainer dispatches (hetero fleets the
+    per-class ``fleet_superstep``, homogeneous series presets the
+    ``series_superstep``; serving always lowers ``serve_bucket``). Pure
+    config — no dataset build, no trace — so record writers can stamp
+    manifests without touching a backend."""
+    return {
+        p: {
+            "train": (
+                "fleet_superstep"
+                if composed_config(p).data.hetero
+                else "series_superstep"
+            ),
+            "serve": "serve_bucket",
+        }
+        for p in COMPOSED_PRESETS
+    }
+
+
+def composed_trainer(
+    name: str,
+    *,
+    twin: str | None = None,
+    out_dir: str | None = None,
+    epochs: int | None = None,
+    fault_plan=None,
+    verbose: bool = False,
+):
+    """Build the preset's composed trainer (or its parity twin).
+
+    ``twin=None`` builds the mesh-composed trainer;
+    ``twin="single"`` the 1-device twin (dense presets only — banded
+    layouts are functions of the mesh config); ``twin="per_step"`` the
+    per-step loop on the same mesh. Both twins share the composed
+    trainer's param init bit-for-bit.
+    """
+    from stmgcn_tpu.config import MeshConfig
+    from stmgcn_tpu.experiment import build_dataset, build_trainer
+
+    cfg = composed_config(name)
+    if epochs is not None:
+        cfg.train.epochs = epochs
+    if out_dir is not None:
+        cfg.train.out_dir = out_dir
+    if twin == "single":
+        if _TWIN[name] != "single":
+            raise ValueError(
+                f"{name!r} has no single-device twin (its banded routing/"
+                "param layout derives from the mesh config); use "
+                'twin="per_step"'
+            )
+        cfg.mesh = MeshConfig()
+    elif twin == "per_step":
+        cfg.train.steps_per_superstep = 1
+    elif twin is not None:
+        raise ValueError(f'twin must be None, "single", or "per_step", got {twin!r}')
+    dataset = None
+    if name == "bandedbranch":
+        # the preset's synthetic transport graph is unbandable by design
+        # (see the preset docstring) — stand in banded city adjacencies so
+        # the branch-stacked halo composition actually engages, as it does
+        # on real banded city pairs
+        dataset = build_dataset(cfg)
+        n = dataset.n_nodes
+        dataset.adjs = {"g0": _band_adj(n, 1, 1), "g1": _band_adj(n, 2, 2)}
+    trainer = build_trainer(
+        cfg, verbose=verbose, fault_plan=fault_plan, dataset=dataset
+    )
+    if name in ("scaled", "bandedbranch") and twin is None:
+        banded = [
+            s
+            for s in (
+                trainer.supports
+                if isinstance(trainer.supports, tuple)
+                else (trainer.supports,)
+            )
+            if hasattr(s, "halo")
+        ]
+        if not banded:
+            raise RuntimeError(
+                f"composed {name!r}: routing did not engage the banded "
+                "plan — the shrink no longer matches the router's "
+                "bandwidth budget"
+            )
+    return trainer
+
+
+def banded_meta(trainer, cfg) -> dict:
+    """Analytic wire-model inputs for a banded composed program
+    (``spmd_check``'s halo permute bound): measured halo from the routed
+    strips plus per-shard batch/graph/feature extents from the config.
+    Empty for dense programs."""
+    banded = [
+        s
+        for s in (
+            trainer.supports
+            if isinstance(trainer.supports, tuple)
+            else (trainer.supports,)
+        )
+        if hasattr(s, "halo")
+    ]
+    if not banded:
+        return {}
+    f_cap = (
+        cfg.data.serial_len
+        + cfg.data.daily_len
+        + cfg.data.weekly_len
+        + 2 * cfg.model.lstm_hidden_dim
+        + cfg.model.gcn_hidden_dim
+    )
+    return {
+        "halo": max(s.halo for s in banded),
+        "b_local": cfg.train.batch_size // cfg.mesh.dp,
+        "m_local": max(1, cfg.model.m_graphs // cfg.mesh.branch),
+        "f_cap": f_cap,
+    }

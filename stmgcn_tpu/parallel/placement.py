@@ -23,6 +23,19 @@ Array-kind conventions (shapes as in the model):
   axis, leaves under the vmapped ``branches`` subtree shard their leading
   ``(M, ...)`` axis over it (the fusion sum becomes a ``psum``) — branch
   model parallelism, the expert-parallel analogue for this model family
+
+Window-free resident-series kinds (the composed multi-chip fast path —
+the fused superstep consumes the resident series through
+``gather_window_batch`` instead of placed window arrays):
+
+- ``series`` ``(T, N, C)`` — ``P(None, 'region', None)``: the node axis
+  shards; time stays whole so every shard's window gather is local
+- ``index`` — int vectors/blocks that select *samples*: ``(B,)`` →
+  ``P('dp')``, superstep ``(S, B)`` blocks → ``P(None, 'dp')``
+- ``mask_block`` — superstep mask stacks: ``(S, B)`` → ``P(None, 'dp')``,
+  node-padded ``(S, B, N)`` → ``P(None, 'dp', 'region')``
+- ``replicated`` — small int vectors every shard needs whole (window
+  target/offset tables, fleet slot ids) — ``P()``
 """
 
 from __future__ import annotations
@@ -74,6 +87,10 @@ class MeshPlacement:
         "y": P("dp", "region", None),
         "mask": P("dp",),
         "state": P(),
+        "series": P(None, "region", None),
+        "index": P("dp",),
+        "mask_block": P(None, "dp"),
+        "replicated": P(),
     }
 
     def __init__(self, mesh: Mesh):
@@ -88,6 +105,12 @@ class MeshPlacement:
         if kind == "mask" and ndim == 2:
             # (B, N) sample x node mask (node-padded meshes)
             return P("dp", "region")
+        if kind == "index" and ndim == 2:
+            # (S, B) superstep index blocks: steps stay whole, batch shards
+            return P(None, "dp")
+        if kind == "mask_block" and ndim == 3:
+            # (S, B, N) node-padded superstep mask stacks
+            return P(None, "dp", "region")
         return self.SPECS[kind]
 
     def sharding(self, kind: str, ndim: int = 3) -> NamedSharding:

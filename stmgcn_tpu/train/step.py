@@ -704,6 +704,7 @@ def make_series_superstep_fns(
     health: bool = False,
     precision: str = "fp32",
     sr_seed: Optional[int] = None,
+    placement=None,
 ) -> SeriesSuperstepFns:
     """The superstep of :func:`make_superstep_fns` over window-free data.
 
@@ -720,6 +721,16 @@ def make_series_superstep_fns(
     ``health=True`` adds the per-step :func:`_health_stats` scan ys
     (same semantics and bit-identity guarantees as there).
     ``precision``/``sr_seed`` behave as in :func:`make_superstep_fns`.
+
+    ``placement`` (a :class:`~stmgcn_tpu.parallel.MeshPlacement`, or
+    ``None`` off-mesh) is the composed multi-chip fast path: the gathered
+    per-step ``x``/``y`` get an in-scan ``with_sharding_constraint`` to
+    the mesh's batch-sharded specs, so GSPMD keeps every window gather
+    device-local per dp shard and places the gradient ``psum`` *inside*
+    the S-step scan body — one while-loop program whose per-iteration
+    wire is exactly the per-step program's. ``placement=None`` traces the
+    byte-identical single-device program (the constraint is a trace-time
+    Python branch, so jaxpr/primitive budgets are unchanged).
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
@@ -741,6 +752,13 @@ def make_series_superstep_fns(
                 idx, mask = step_inputs
                 sr_rng = None
             x, y = gather_window_batch(series, targets, offsets, idx, horizon)
+            if placement is not None:
+                x = jax.lax.with_sharding_constraint(
+                    x, placement.sharding("x", x.ndim)
+                )
+                y = jax.lax.with_sharding_constraint(
+                    y, placement.sharding("y", y.ndim)
+                )
             if health:
                 params, opt_state, loss_val, grads, updates, prev = (
                     train_step_full(
@@ -797,6 +815,7 @@ def make_fleet_superstep_fns(
     health: bool = False,
     precision: str = "fp32",
     sr_seed: Optional[int] = None,
+    placement=None,
 ) -> FleetSuperstepFns:
     """The window-free superstep of :func:`make_series_superstep_fns`
     generalized to one fleet *shape class* of cities.
@@ -823,7 +842,11 @@ def make_fleet_superstep_fns(
     slot — summing it over both axes reproduces the summed fleet loss
     exactly, and per-slot columns attribute it city by city.
 
-    ``precision``/``sr_seed`` behave as in :func:`make_superstep_fns`.
+    ``precision``/``sr_seed`` behave as in :func:`make_superstep_fns`;
+    ``placement`` is the in-scan sharding constraint of
+    :func:`make_series_superstep_fns` (dp-sharded gathered batches, grad
+    psum inside the scan body; ``None`` traces the byte-identical
+    single-device program).
     """
     if checks is not None and checks not in CHECK_SETS:
         raise ValueError(f"checks must be one of {CHECK_SETS}, got {checks!r}")
@@ -854,6 +877,13 @@ def make_fleet_superstep_fns(
                 lambda a: jnp.take(a, slot, axis=0), supports_stack
             )
             x, y = gather_window_batch(series, targets, offsets, idx, horizon)
+            if placement is not None:
+                x = jax.lax.with_sharding_constraint(
+                    x, placement.sharding("x", x.ndim)
+                )
+                y = jax.lax.with_sharding_constraint(
+                    y, placement.sharding("y", y.ndim)
+                )
             if health:
                 params, opt_state, loss_val, grads, updates, prev = (
                     train_step_full(

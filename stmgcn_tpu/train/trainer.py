@@ -401,14 +401,15 @@ class Trainer:
         # leaves the epoch entirely (SURVEY.md §7 "device_put once" for
         # small configs; the reference's whole-split residency, quirk 7,
         # without its eager-in-the-dataset placement). Mesh placements
-        # stream: resident gathers would need per-shard index translation
-        # for data that mesh configs assume is too big to replicate anyway.
+        # compose with residency only through the window-free gather: the
+        # (T, N, C) series shards its node axis over 'region' and the
+        # (S, B) index blocks shard over 'dp', so every window gather
+        # stays device-local per shard — no per-shard index translation.
+        # Materialized windows on a mesh still stream (their resident
+        # form has no shardable layout); mesh "auto" also streams unless
+        # window_free=True opts in, keeping default mesh runs unchanged.
         meshy = hasattr(self.placement, "mesh")
-        if self.data_placement == "resident" and meshy:
-            raise ValueError(
-                "data_placement='resident' requires a single-device "
-                "placement; mesh runs stream batches (with prefetch)"
-            )
+        self._meshy = meshy
         # Window-free residency needs the series/targets protocol — both
         # the homogeneous DemandDataset and the heterogeneous dataset
         # (per-city series delegation) speak it; custom datasets without
@@ -423,6 +424,14 @@ class Trainer:
                 "dataset only materializes windows"
             )
         wf_candidate = wf_supported and window_free is not False
+        if self.data_placement == "resident" and meshy and not wf_candidate:
+            raise ValueError(
+                "data_placement='resident' on a mesh placement composes "
+                "only through the window-free gather (window_free must "
+                "not be False and the dataset must speak the "
+                "series/mode_targets protocol); materialized windows "
+                "stream on meshes"
+            )
         # "auto" sizes against what would actually sit in HBM: the raw
         # series (+ targets) on the window-free path — ~seq_len x smaller
         # — so long-window configs stop being capacity-bound here
@@ -431,7 +440,7 @@ class Trainer:
         )
         self._resident = self.data_placement == "resident" or (
             self.data_placement == "auto"
-            and not meshy
+            and (not meshy or window_free is True)
             and resident_bytes <= self._resident_cap_bytes()
         )
         #: resident batches gather from the raw series on device instead of
@@ -493,6 +502,7 @@ class Trainer:
                 model, self._optimizer, loss,
                 horizon=self._horizon, checks=checks, health=health,
                 precision=precision, sr_seed=sr_seed,
+                placement=self.placement if self._meshy else None,
             )
             if self._window_free
             else make_superstep_fns(
@@ -539,6 +549,7 @@ class Trainer:
             model, self._optimizer, loss, horizon=self._horizon,
             checks=checks, health=health,
             precision=precision, sr_seed=sr_seed,
+            placement=self.placement if self._meshy else None,
         )
         if fleet_max_classes < 1:
             raise ValueError(f"fleet_max_classes must be >= 1, got {fleet_max_classes}")
@@ -1161,7 +1172,9 @@ class Trainer:
         # identical mask broadcast to stay bit-exact with it
         force = batch.city in self._fleet_cities
         if self._resident and batch.indices is not None:
-            idx = jnp.asarray(batch.indices)  # a few hundred bytes, not the data
+            # a few hundred bytes, not the data; dp-sharded on a mesh so
+            # the window gather (and its output) stays per-shard local
+            idx = self.placement.put(np.asarray(batch.indices), "index")
             if self._window_free:
                 # reconstruct (x, y) on device from the resident raw
                 # series: index -> target timestep -> target + offsets
@@ -1263,7 +1276,7 @@ class Trainer:
             pad = self._pad_for(city)
             if pad:
                 s = self._pad_nodes(s, 1, pad)
-            self._resident_series_cache[city] = self.placement.put(s, "x")
+            self._resident_series_cache[city] = self.placement.put(s, "series")
         return self._resident_series_cache[city]
 
     def _resident_targets(self, mode: str, city: int):
@@ -1282,14 +1295,17 @@ class Trainer:
                 t = self.dataset.mode_targets(
                     mode, None if self.dataset.shared_graphs else city
                 )
-            self._resident_targets_cache[key] = self.placement.put(t, "x")
+            self._resident_targets_cache[key] = self.placement.put(
+                t, "replicated"
+            )
         return self._resident_targets_cache[key]
 
     def _offsets_device(self):
         """Device copy of the window's gather-offset table."""
         if self._offsets_dev is None:
             self._offsets_dev = self.placement.put(
-                np.asarray(self.dataset.window.offsets, np.int32), "x"
+                np.asarray(self.dataset.window.offsets, np.int32),
+                "replicated",
             )
         return self._offsets_dev
 
@@ -1297,6 +1313,22 @@ class Trainer:
         widths = [(0, 0)] * arr.ndim
         widths[axis] = (0, pad)
         return np.pad(arr, widths)
+
+    def _place_block(self, idx_np, mask_np):
+        """Device placement of one packed ``(S, B)`` superstep block.
+
+        On a mesh placement the index block shards its batch axis over
+        ``dp`` and the mask block follows (``(S, B, N)`` masks shard the
+        node axis over ``region`` too), so the fused program's in-scan
+        gathers run shard-local; off-mesh this is the plain async upload
+        the double buffer relies on.
+        """
+        if self._meshy:
+            return (
+                self.placement.put(idx_np, "index"),
+                self.placement.put(mask_np, "mask_block"),
+            )
+        return jnp.asarray(idx_np), jnp.asarray(mask_np)
 
     def _superstep_ready(self) -> bool:
         """Whether training epochs can take the fused superstep path.
@@ -1344,7 +1376,7 @@ class Trainer:
                     s = self._pad_nodes(s, 1, pad)
                 parts.append(s)
             self._fleet_series_cache[cls_id] = self.placement.put(
-                np.concatenate(parts, axis=0), "x"
+                np.concatenate(parts, axis=0), "series"
             )
         return self._fleet_series_cache[cls_id]
 
@@ -1365,7 +1397,7 @@ class Trainer:
                 base += t.shape[0]
                 parts.append(t)
             self._fleet_targets_cache[key] = (
-                self.placement.put(np.concatenate(parts), "x"),
+                self.placement.put(np.concatenate(parts), "replicated"),
                 bases,
             )
         return self._fleet_targets_cache[key]
@@ -1384,6 +1416,102 @@ class Trainer:
                 lambda *leaves: jnp.stack(leaves), *members
             )
         return self._fleet_supports_cache[cls_id]
+
+    def composed_program(self, mode: str = "train"):
+        """The engaged fused train program with one real packed block.
+
+        Returns ``(name, fn, args)`` where ``fn`` is the jitted superstep
+        the training epochs dispatch (``train_path`` names which) and
+        ``args`` is a complete operand tuple built exactly the way
+        :meth:`_run_train_epoch_superstep` / :meth:`_run_train_epoch_fleet`
+        build it — resident operands placed by kind, the first packed
+        ``(S, B)`` block placed through :meth:`_place_block`. This is the
+        REAL composed program: ``analysis/spmd_check.py`` lowers it for
+        the static SPMD audit and ``scripts/lint_gate.sh`` smokes it, so
+        execution and certification share one program by construction.
+
+        Raises ``ValueError`` when no fused path engaged (per-step
+        trainers have no composed program to certify).
+
+        The state operands are copies: the fused programs donate
+        ``(params, opt_state)``, so executing ``fn(*args)`` must not
+        invalidate the trainer's live buffers (one execution per returned
+        ``args`` tuple — the copies are donated in turn).
+        """
+        params = jax.tree.map(jnp.copy, self.params)
+        opt_state = jax.tree.map(jnp.copy, self.opt_state)
+        S = self.steps_per_superstep
+        batches = list(self.dataset.batches(
+            mode, self.batch_size, shuffle=False, seed=self.seed,
+            epoch=self.epoch, pad_last=True, with_arrays=False,
+        ))
+        if self._superstep_ready():
+            if self._superstep_fns is None:
+                self._superstep_fns = self._make_superstep_fns()
+            blocks, _ = self._pack_blocks(batches, mode)
+            if not blocks:
+                raise ValueError(
+                    f"fewer than steps_per_superstep={S} batches in "
+                    f"{mode!r} — no full block to compose"
+                )
+            idx_np, mask_np, _ = blocks[0]
+            idx_d, mask_d = self._place_block(idx_np, mask_np)
+            if self._window_free:
+                return (
+                    "series_superstep",
+                    self._superstep_fns.train_superstep,
+                    (
+                        params, opt_state, self.supports,
+                        self._resident_series(0),
+                        self._resident_targets(mode, 0),
+                        self._offsets_device(), idx_d, mask_d,
+                    ),
+                )
+            x_all, y_all = self._resident_arrays(mode, 0)
+            return (
+                "superstep",
+                self._superstep_fns.train_superstep,
+                (
+                    params, opt_state, self.supports,
+                    x_all, y_all, idx_d, mask_d,
+                ),
+            )
+        if self._fleet_superstep_ready():
+            if self._fleet_fns is None:
+                self._fleet_fns = self._make_fleet_fns()
+            for city, info in self._fleet_cities.items():
+                run = [b for b in batches if b.city == city]
+                targets, bases = self._fleet_targets(mode, info.cls)
+                blocks, _ = self._pack_fleet_blocks(run, info, bases[city])
+                if not blocks:
+                    continue
+                idx_np, mask_np, _ = blocks[0]
+                idx_d, mask_d = self._place_block(idx_np, mask_np)
+                slot_d = jnp.full((S,), info.slot, jnp.int32)
+                nr_d = jnp.full((S,), info.n_real, jnp.int32)
+                if self._meshy:
+                    slot_d = self.placement.put(slot_d, "replicated")
+                    nr_d = self.placement.put(nr_d, "replicated")
+                return (
+                    "fleet_superstep",
+                    self._fleet_fns.train_superstep,
+                    (
+                        params, opt_state,
+                        self._fleet_supports(info.cls),
+                        self._fleet_series(info.cls), targets,
+                        self._offsets_device(), idx_d, mask_d, slot_d, nr_d,
+                    ),
+                )
+            raise ValueError(
+                "fleet plan engaged but no city packed a full "
+                f"steps_per_superstep={S} block in {mode!r}"
+            )
+        raise ValueError(
+            "no fused program engaged (train_path="
+            f"{self.train_path!r}, fallback_reason={self.fallback_reason!r})"
+            " — the composed-program audit needs steps_per_superstep > 1 "
+            "on a resident trainer"
+        )
 
     def _run_epoch(self, mode: str, train: bool) -> float:
         """Sample-weighted mean loss over a mode (``Model_Trainer.py:43-44``).
@@ -1681,7 +1809,7 @@ class Trainer:
 
         def place(block):
             idx_np, mask_np, n_reals = block
-            return jnp.asarray(idx_np), jnp.asarray(mask_np), n_reals
+            return (*self._place_block(idx_np, mask_np), n_reals)
 
         if trc is None:
             placer = place  # the hot loop binds the raw fn: zero obs cost
@@ -1841,7 +1969,7 @@ class Trainer:
 
         def place(block):
             idx_np, mask_np, n_reals = block
-            return jnp.asarray(idx_np), jnp.asarray(mask_np), n_reals
+            return (*self._place_block(idx_np, mask_np), n_reals)
 
         trc = obs_trace.active_tracer()
         if trc is None:
@@ -1871,6 +1999,9 @@ class Trainer:
             )
             slot_d = jnp.full((S,), info.slot, jnp.int32)
             nr_d = jnp.full((S,), info.n_real, jnp.int32)
+            if self._meshy:  # every shard selects the same slot / divisor
+                slot_d = self.placement.put(slot_d, "replicated")
+                nr_d = self.placement.put(nr_d, "replicated")
 
             def per_step_block(i, run=run):
                 for batch in run[i * S:(i + 1) * S]:

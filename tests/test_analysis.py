@@ -1315,9 +1315,15 @@ class TestResidentMemoryRule:
 
         bad = preset("multicity")  # dp=8 mesh
         bad.train.data_placement = "resident"
+        bad.train.window_free = False  # materialized windows on a mesh
         f = check_resident_memory([("bad", bad)])
         assert [x.rule for x in f] == ["resident-memory"]
         assert any("mesh" in x.message for x in f)
+        # the window-free composition (the composed multi-chip path) is
+        # legal now — no finding without the materialized forcing
+        ok = preset("multicity")
+        ok.train.data_placement = "resident"
+        assert check_resident_memory([("ok", ok)]) == []
 
     def test_auto_placement_skipped(self):
         """"auto" degrades to streaming by design — an oversized auto
@@ -2598,13 +2604,21 @@ class TestLintGateScript:
             payload["federation"]["cities"]
         assert payload["federation"]["cities"] > 0
         assert payload["federation"]["findings"] == 0
-        # the spmd contract section: every probe program lowered on the
-        # virtual mesh, collectives observed, zero manifest/wire/
+        # the spmd contract section: every composed program lowered on
+        # the virtual mesh, collectives observed, zero manifest/wire/
         # footprint findings
         assert payload["spmd"]["exit"] == 0
         assert payload["spmd"]["programs"] > 0
         assert payload["spmd"]["collectives"] > 0
         assert payload["spmd"]["findings"] == 0
+        # the spmd execution smoke: the composed superstep RAN on the
+        # 8-virtual-device substrate as the fused mesh program,
+        # bit-identical to its single-device twin, zero recompiles
+        # after its warmup epoch
+        assert payload["spmd_exec"] == {
+            "exit": 0, "program": "series_superstep", "n_devices": 8,
+            "parity_drift": 0.0, "recompiles_after_warmup": 0,
+        }
         # the precision dataflow section: every registered contract
         # program dtype-walked — including the bf16 mixed-precision
         # twins — sites classified, zero policy findings

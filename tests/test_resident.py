@@ -94,10 +94,21 @@ def test_index_only_batches_skip_host_copies():
 
 
 def test_resident_rejected_on_mesh():
+    """Materialized windows still refuse the mesh; the window-free gather
+    (the composed multi-chip fast path) is the supported composition."""
     cfg = preset("multicity")
     cfg.train.data_placement = "resident"
-    with pytest.raises(ValueError, match="resident"):
+    cfg.train.window_free = False
+    with pytest.raises(ValueError, match="window-free"):
         build_trainer(cfg, verbose=False)
+
+
+def test_resident_on_mesh_composes_window_free():
+    cfg = preset("multicity")
+    cfg.train.data_placement = "resident"
+    trainer = build_trainer(cfg, verbose=False)
+    assert trainer._resident is True
+    assert trainer._window_free is True
 
 
 def test_mesh_auto_streams():
